@@ -1,0 +1,15 @@
+"""seaweedfs_tpu — a TPU-native erasure-coding framework.
+
+A from-scratch rebuild of the capabilities of SeaweedFS's erasure-coding
+pipeline (reference: samson-wang/seaweedfs, weed/storage/erasure_coding/)
+designed for TPU hardware: the GF(2^8) Reed-Solomon codec runs as a
+bitsliced GF(2) XOR network on the TPU VPU (with an XLA:CPU fallback), the
+volume/shard on-disk formats are bit-compatible with the reference, and the
+``ec.encode`` / ``ec.decode`` / ``ec.rebuild`` command and gRPC surfaces
+mirror the reference's shell and volume-server APIs.
+
+See SURVEY.md at the repo root for the structural analysis this build
+follows, and BASELINE.md for the performance targets.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Device and host codecs: GF(2^8) math, oracle RS, bitsliced JAX/Pallas."""

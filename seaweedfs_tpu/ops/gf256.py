@@ -1,0 +1,222 @@
+"""GF(2^8) arithmetic and matrix algebra for Reed-Solomon coding.
+
+This is the TPU-native rebuild of the math layer the reference delegates to
+its vendored ``github.com/klauspost/reedsolomon`` dependency (``galois.go``,
+``matrix.go``, ``inversion_tree.go``; see SURVEY.md §2 L0 row — the reference
+mount was empty at survey time, so paths are the expected upstream layout and
+line numbers are deliberately omitted).
+
+Field: GF(2^8) with the primitive polynomial ``x^8 + x^4 + x^3 + x^2 + 1``
+(0x11D) and generator 2 — the same field klauspost/reedsolomon uses, so code
+matrices and therefore parity bytes match the reference byte-for-byte.
+
+Everything here is host-side NumPy: table construction, code-matrix
+construction (Vandermonde made systematic, klauspost ``buildMatrix``
+semantics), and Gauss-Jordan inversion used to derive decode matrices. The
+device-side codec (ops/rs_jax.py) consumes only the small uint8 matrices
+produced here; per-byte GF multiplication never happens on the device — it is
+bitsliced into GF(2) XOR networks instead (see ops/bitslice.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+#: The primitive polynomial for GF(2^8), matching klauspost/reedsolomon
+#: (galois.go) and therefore the reference's on-disk parity bytes.
+PRIMITIVE_POLY = 0x11D
+
+#: Field generator (alpha).
+GENERATOR = 2
+
+
+def _carryless_mul(a: int, b: int) -> int:
+    """Polynomial multiply mod PRIMITIVE_POLY, table-free (bootstraps the
+    tables; also what the table-driven paths are tested against)."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= PRIMITIVE_POLY
+    return r
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """Build exp/log tables for GF(2^8) over PRIMITIVE_POLY and GENERATOR.
+
+    exp has 512 entries so products of two logs (< 510) index without a
+    modulo; log[0] is unused (log of zero is undefined).
+    """
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x = _carryless_mul(x, GENERATOR)
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Multiply two field elements."""
+    if a == 0 or b == 0:
+        return 0
+    return int(EXP_TABLE[LOG_TABLE[a] + LOG_TABLE[b]])
+
+
+def gf_div(a: int, b: int) -> int:
+    """Divide a by b (b != 0)."""
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] - LOG_TABLE[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    """Multiplicative inverse."""
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of zero")
+    return int(EXP_TABLE[255 - LOG_TABLE[a]])
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a ** n in the field, with klauspost ``galExp`` edge cases:
+    a^0 == 1 for every a (including 0); 0^n == 0 for n > 0."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] * n) % 255])
+
+
+@functools.lru_cache(maxsize=1)
+def mul_table() -> np.ndarray:
+    """Full 256x256 multiplication table, MUL[a, b] = a*b.
+
+    Used by the NumPy oracle codec (ops/rs_ref.py) for vectorized
+    constant-times-buffer products; never shipped to the device.
+    """
+    a = np.arange(256)
+    la = LOG_TABLE[a][:, None]  # (256, 1)
+    lb = LOG_TABLE[a][None, :]  # (1, 256)
+    prod = EXP_TABLE[(la + lb) % 255].astype(np.uint8)
+    prod[0, :] = 0
+    prod[:, 0] = 0
+    return prod
+
+
+def gf_mul_bytes(c: int, buf: np.ndarray) -> np.ndarray:
+    """Multiply every byte of ``buf`` (uint8 array) by constant ``c``."""
+    if c == 0:
+        return np.zeros_like(buf)
+    if c == 1:
+        return buf.copy()
+    return mul_table()[c][buf]
+
+
+# ---------------------------------------------------------------------------
+# Matrix algebra over GF(2^8) (klauspost matrix.go semantics)
+# ---------------------------------------------------------------------------
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8); a (r,n) uint8, b (n,c) uint8."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"shape mismatch {a.shape} @ {b.shape}")
+    mt = mul_table()
+    # products[r, c, n] = a[r, n] * b[n, c]; XOR-reduce over n.
+    products = mt[a[:, None, :], b.T[None, :, :]]
+    return np.bitwise_xor.reduce(products, axis=2)
+
+
+def gf_identity(n: int) -> np.ndarray:
+    return np.eye(n, dtype=np.uint8)
+
+
+def gf_matrix_invert(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(2^8).
+
+    Raises ValueError on singular input (klauspost returns
+    errSingular — callers treat it as "these shard rows cannot decode").
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    n = m.shape[0]
+    if m.shape != (n, n):
+        raise ValueError("matrix must be square")
+    work = np.concatenate([m.copy(), gf_identity(n)], axis=1)
+    mt = mul_table()
+    for col in range(n):
+        # Partial pivot: any row with a nonzero in this column.
+        pivot = None
+        for r in range(col, n):
+            if work[r, col] != 0:
+                pivot = r
+                break
+        if pivot is None:
+            raise ValueError("singular matrix over GF(2^8)")
+        if pivot != col:
+            work[[col, pivot]] = work[[pivot, col]]
+        # Scale pivot row to make the pivot 1.
+        pv = int(work[col, col])
+        if pv != 1:
+            work[col] = mt[gf_inv(pv)][work[col]]
+        # Eliminate this column from every other row.
+        col_vals = work[:, col].copy()
+        col_vals[col] = 0
+        nz = np.nonzero(col_vals)[0]
+        if nz.size:
+            work[nz] ^= mt[col_vals[nz][:, None], work[col][None, :]]
+    return work[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """Vandermonde matrix v[r, c] = r ** c in GF(2^8).
+
+    Matches klauspost matrix.go ``vandermonde``: row 0 is [1, 0, 0, ...]
+    because galExp(0, 0) == 1 and galExp(0, c>0) == 0.
+    """
+    v = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            v[r, c] = gf_exp(r, c)
+    return v
+
+
+@functools.lru_cache(maxsize=64)
+def build_code_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """The systematic (total x data) code matrix, klauspost ``buildMatrix``.
+
+    Take the (total x data) Vandermonde matrix, right-multiply by the
+    inverse of its top (data x data) square so the top becomes identity;
+    the bottom ``total - data`` rows are the parity coefficients. Any
+    ``data`` rows of the result form an invertible matrix, which is what
+    makes reconstruction from any k surviving shards possible.
+    """
+    if data_shards <= 0 or total_shards <= data_shards:
+        raise ValueError("need 0 < data_shards < total_shards")
+    if total_shards > 256:
+        raise ValueError("GF(2^8) Reed-Solomon supports at most 256 shards")
+    vm = vandermonde(total_shards, data_shards)
+    top = vm[:data_shards, :data_shards]
+    result = gf_matmul(vm, gf_matrix_invert(top))
+    result.setflags(write=False)
+    return result
+
+
+def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Just the (parity x data) coefficient block of the code matrix."""
+    full = build_code_matrix(data_shards, data_shards + parity_shards)
+    return full[data_shards:, :]

@@ -1,0 +1,191 @@
+"""NumPy reference Reed-Solomon codec — the correctness oracle.
+
+Mirrors the method surface of ``klauspost/reedsolomon``'s ``Encoder``
+interface (reedsolomon.go; SURVEY.md §2 L0 row), which is the contract the
+reference's EC layer (weed/storage/erasure_coding/ec_encoder.go,
+ec_decoder.go) programs against:
+
+    New(k, m) -> Encoder
+    Encode(shards)            # fill parity from data
+    Verify(shards) -> bool    # parity consistent with data?
+    Reconstruct(shards)       # rebuild ALL missing shards in place
+    ReconstructData(shards)   # rebuild only missing data shards
+    Split(data) -> shards     # slice a buffer into k padded data shards
+    Join(dst, shards, size)   # concatenate data shards, trim to size
+
+The role klauspost plays for the reference — "correct by construction, fast
+on the host" — this module plays for the TPU build: every device codec
+(ops/rs_jax.py, ops/pallas_gf.py) is property-tested against this oracle.
+It is deliberately simple NumPy; speed comes from the device paths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import gf256
+
+
+class ShardSizeError(ValueError):
+    pass
+
+
+class TooFewShardsError(ValueError):
+    pass
+
+
+class ReferenceEncoder:
+    """Parametrized RS(k, m) codec over GF(2^8), klauspost semantics.
+
+    ``k`` data shards, ``m`` parity shards, tolerates any ``m`` losses.
+    The reference hardcodes k=10, m=4 (ec_encoder.go DataShardsCount /
+    ParityShardsCount); BASELINE.json config 4 requires the parametrized
+    form, so (k, m) are constructor arguments here.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("data_shards and parity_shards must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("at most 256 total shards in GF(2^8)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.build_code_matrix(data_shards, self.total_shards)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _check_shards(self, shards: Sequence[Optional[np.ndarray]],
+                      nil_ok: bool) -> int:
+        if len(shards) != self.total_shards:
+            raise ShardSizeError(
+                f"expected {self.total_shards} shards, got {len(shards)}")
+        size = -1
+        for s in shards:
+            if s is None:
+                if not nil_ok:
+                    raise ShardSizeError("unexpected missing shard")
+                continue
+            if size == -1:
+                size = len(s)
+            elif len(s) != size:
+                raise ShardSizeError("shards have inconsistent sizes")
+        if size <= 0:
+            raise ShardSizeError("no shard data")
+        return size
+
+    def _code_some(self, coef_rows: np.ndarray,
+                   inputs: Sequence[np.ndarray]) -> list[np.ndarray]:
+        """outputs[r] = XOR_j coef_rows[r, j] * inputs[j] (the codeSomeShards
+        loop that klauspost's galois_amd64.s accelerates on the host)."""
+        outs = []
+        for r in range(coef_rows.shape[0]):
+            acc = np.zeros_like(inputs[0])
+            for j, inp in enumerate(inputs):
+                c = int(coef_rows[r, j])
+                if c == 0:
+                    continue
+                acc ^= gf256.gf_mul_bytes(c, inp)
+            outs.append(acc)
+        return outs
+
+    # -- Encoder surface --------------------------------------------------
+
+    def encode(self, shards: list[np.ndarray]) -> None:
+        """Fill shards[k:] (parity) from shards[:k] (data), in place."""
+        self._check_shards(shards, nil_ok=False)
+        parity = self._code_some(self.matrix[self.data_shards:],
+                                 shards[:self.data_shards])
+        for i, p in enumerate(parity):
+            shards[self.data_shards + i][:] = p
+
+    def encode_parity(self, data: np.ndarray) -> np.ndarray:
+        """Functional form: data (k, S) uint8 -> parity (m, S) uint8."""
+        data = np.asarray(data, dtype=np.uint8)
+        if data.shape[0] != self.data_shards:
+            raise ShardSizeError(
+                f"expected {self.data_shards} data rows, got {data.shape[0]}")
+        parity = self._code_some(self.matrix[self.data_shards:], list(data))
+        return np.stack(parity, axis=0)
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        self._check_shards(shards, nil_ok=False)
+        expect = self._code_some(self.matrix[self.data_shards:],
+                                 list(shards[:self.data_shards]))
+        return all(np.array_equal(e, s)
+                   for e, s in zip(expect, shards[self.data_shards:]))
+
+    def reconstruct(self, shards: list[Optional[np.ndarray]],
+                    data_only: bool = False) -> None:
+        """Rebuild missing (None) shards in place from any k survivors.
+
+        klauspost ``reconstruct``: pick the first k present shards, invert
+        the corresponding k rows of the code matrix, apply the inverse rows
+        for missing data shards, then (unless data_only) re-encode missing
+        parity from the completed data shards.
+        """
+        size = self._check_shards(shards, nil_ok=True)
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) == self.total_shards:
+            return
+        if len(present) < self.data_shards:
+            raise TooFewShardsError(
+                f"need {self.data_shards} shards, have {len(present)}")
+
+        sub_rows = present[:self.data_shards]
+        sub_matrix = self.matrix[sub_rows, :]
+        decode_matrix = gf256.gf_matrix_invert(sub_matrix)
+        sub_shards = [shards[i] for i in sub_rows]
+
+        missing_data = [i for i in range(self.data_shards)
+                        if shards[i] is None]
+        if missing_data:
+            rows = decode_matrix[missing_data, :]
+            rebuilt = self._code_some(rows, sub_shards)
+            for i, buf in zip(missing_data, rebuilt):
+                shards[i] = buf
+        if data_only:
+            return
+
+        missing_parity = [i for i in range(self.data_shards,
+                                           self.total_shards)
+                          if shards[i] is None]
+        if missing_parity:
+            rows = self.matrix[missing_parity, :]
+            rebuilt = self._code_some(rows, [shards[i] for i in
+                                             range(self.data_shards)])
+            for i, buf in zip(missing_parity, rebuilt):
+                shards[i] = buf
+
+    def reconstruct_data(self, shards: list[Optional[np.ndarray]]) -> None:
+        self.reconstruct(shards, data_only=True)
+
+    def split(self, data: bytes | np.ndarray) -> list[np.ndarray]:
+        """Split a buffer into k+m equal shards: k data shards carrying the
+        buffer (last one zero-padded) plus m zeroed parity shards, matching
+        klauspost ``Split`` which returns ``total_shards`` slices ready to
+        pass straight to ``encode``."""
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) else data.astype(np.uint8)
+        if buf.size == 0:
+            raise ShardSizeError("cannot split empty buffer")
+        per = -(-buf.size // self.data_shards)  # ceil
+        padded = np.zeros(per * self.data_shards, dtype=np.uint8)
+        padded[:buf.size] = buf
+        shards = [padded[i * per:(i + 1) * per].copy()
+                  for i in range(self.data_shards)]
+        shards += [np.zeros(per, dtype=np.uint8)
+                   for _ in range(self.parity_shards)]
+        return shards
+
+    def join(self, shards: Sequence[np.ndarray], size: int) -> bytes:
+        """Concatenate the k data shards and trim to ``size`` bytes."""
+        if len(shards) < self.data_shards:
+            raise TooFewShardsError("join needs all data shards")
+        cat = np.concatenate([np.asarray(s, dtype=np.uint8)
+                              for s in shards[:self.data_shards]])
+        if cat.size < size:
+            raise ShardSizeError("shards shorter than requested size")
+        return cat[:size].tobytes()

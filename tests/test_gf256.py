@@ -1,0 +1,119 @@
+"""Field + matrix algebra properties for the GF(2^8) core."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf256.EXP_TABLE[gf256.LOG_TABLE[a]] == a
+
+
+def test_mul_matches_carryless_reference():
+    """Check table-driven gf_mul against a bit-by-bit shift/reduce multiply."""
+    def slow_mul(a, b):
+        r = 0
+        while b:
+            if b & 1:
+                r ^= a
+            b >>= 1
+            a <<= 1
+            if a & 0x100:
+                a ^= gf256.PRIMITIVE_POLY
+        return r
+
+    rng = np.random.default_rng(0)
+    for _ in range(2000):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert gf256.gf_mul(a, b) == slow_mul(a, b)
+
+
+def test_field_axioms_samples():
+    rng = np.random.default_rng(1)
+    for _ in range(500):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == \
+            gf256.gf_mul(gf256.gf_mul(a, b), c)
+        # Distributivity over XOR (field addition).
+        assert gf256.gf_mul(a, b ^ c) == \
+            gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+
+def test_inverse_and_division():
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+        assert gf256.gf_div(a, a) == 1
+    with pytest.raises(ZeroDivisionError):
+        gf256.gf_div(3, 0)
+
+
+def test_gf_exp_edge_cases():
+    assert gf256.gf_exp(0, 0) == 1  # klauspost galExp convention
+    assert gf256.gf_exp(0, 5) == 0
+    assert gf256.gf_exp(7, 0) == 1
+    assert gf256.gf_exp(2, 8) == (0x100 ^ gf256.PRIMITIVE_POLY)
+
+
+def test_mul_table_consistent():
+    mt = gf256.mul_table()
+    rng = np.random.default_rng(2)
+    for _ in range(500):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert mt[a, b] == gf256.gf_mul(a, b)
+
+
+def test_matrix_invert_roundtrip():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 5, 10):
+        # Random invertible matrix: retry until nonsingular.
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.gf_matrix_invert(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf256.gf_matmul(m, inv), gf256.gf_identity(n))
+        assert np.array_equal(gf256.gf_matmul(inv, m), gf256.gf_identity(n))
+
+
+def test_singular_matrix_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.gf_matrix_invert(m)
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4), (3, 2), (1, 1)])
+def test_code_matrix_systematic_and_mds(k, m):
+    full = gf256.build_code_matrix(k, k + m)
+    assert full.shape == (k + m, k)
+    # Systematic: top k rows are identity (data shards pass through).
+    assert np.array_equal(full[:k], gf256.gf_identity(k))
+    # MDS property on samples: any k rows are invertible.
+    rng = np.random.default_rng(4)
+    import itertools
+    all_combos = list(itertools.combinations(range(k + m), k))
+    picks = all_combos if len(all_combos) <= 60 else \
+        [all_combos[i] for i in rng.choice(len(all_combos), 60, replace=False)]
+    for rows in picks:
+        sub = full[list(rows), :]
+        gf256.gf_matrix_invert(sub)  # must not raise
+
+
+def test_rs_10_4_parity_matrix_pinned():
+    """The RS(10,4) parity block is fixed by the klauspost buildMatrix
+    construction; pin the exact bytes so any silent change to the field
+    polynomial, generator, or matrix construction is caught — these
+    coefficients determine the bytes that end up on disk in .ec10..ec13
+    (interop surface with real SeaweedFS/klauspost clusters)."""
+    pm = gf256.parity_matrix(10, 4)
+    expected = np.array([
+        [129, 150, 175, 184, 210, 196, 254, 232, 3, 2],
+        [150, 129, 184, 175, 196, 210, 232, 254, 2, 3],
+        [191, 214, 98, 10, 6, 111, 223, 183, 5, 4],
+        [214, 191, 10, 98, 111, 6, 183, 223, 4, 5],
+    ], dtype=np.uint8)
+    assert np.array_equal(pm, expected)
