@@ -34,7 +34,7 @@ GENERATOR = 2
 
 def _carryless_mul(a: int, b: int) -> int:
     """Polynomial multiply mod PRIMITIVE_POLY, table-free (bootstraps the
-    tables; also what the table-driven paths are tested against)."""
+    tables). Tests keep their own independent bit-by-bit reference."""
     r = 0
     while b:
         if b & 1:
@@ -113,6 +113,7 @@ def mul_table() -> np.ndarray:
     prod = EXP_TABLE[(la + lb) % 255].astype(np.uint8)
     prod[0, :] = 0
     prod[:, 0] = 0
+    prod.setflags(write=False)
     return prod
 
 

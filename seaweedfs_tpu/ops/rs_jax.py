@@ -1,0 +1,192 @@
+"""Batched, jittable Reed-Solomon codec for TPU (and XLA:CPU fallback).
+
+This is the device-side replacement for the reference's
+``klauspost/reedsolomon.Encoder`` (SURVEY.md §2 L0): the same method
+surface as ops/rs_ref.py, but operating on batched ``(B, k, S)`` uint8
+arrays through the bitsliced GF(2) XOR network in ops/bitslice.py. One
+``Encoder`` instance serves any batch size; jitted executables are cached
+per (coefficient-matrix, shape) pair, and shard length is padded to the
+128-byte packing group internally (zero bytes encode to zero parity, so
+padding is transparent).
+
+Reconstruction follows klauspost ``reconstruct`` semantics: take the first
+k surviving shard indices, invert those k rows of the code matrix on the
+host (tiny GF(2^8) Gauss-Jordan), and apply the needed rows on-device via
+the same bitsliced primitive used for encode. The inverted matrices are
+memoized per survivor set, mirroring klauspost's inversion_tree.go cache.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import bitslice, gf256
+from .rs_ref import ShardSizeError, TooFewShardsError
+
+GROUP = bitslice.GROUP_BYTES
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int):
+    """One jitted executable per coefficient matrix (shapes polymorphic
+    via jit's own shape cache)."""
+    coefs = np.frombuffer(coefs_bytes, dtype=np.uint8).reshape(n_out, n_in)
+
+    @jax.jit
+    def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
+        return bitslice.apply_gf_matrix(coefs, x)
+
+    return apply_fn
+
+
+def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
+    """Pad-to-group, run the cached executable, slice back."""
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    n_out, n_in = coefs.shape
+    x = jnp.asarray(x, dtype=jnp.uint8)
+    if x.ndim not in (2, 3):
+        raise ValueError(f"expected (n_in, S) or (B, n_in, S), got {x.shape}")
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    s = x.shape[-1]
+    pad = (-s) % GROUP
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    fn = _jitted_apply(coefs.tobytes(), n_out, n_in)
+    y = fn(x)
+    if pad:
+        y = y[..., :s]
+    return y[0] if squeeze else y
+
+
+class Encoder:
+    """Parametrized RS(k, m) with the klauspost Encoder method set,
+    executing on whatever backend JAX targets (TPU v5e here; XLA:CPU is
+    the no-device fallback, mirroring the reference's SIMD CPU path)."""
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("data_shards and parity_shards must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("at most 256 total shards in GF(2^8)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.build_code_matrix(data_shards, self.total_shards)
+        self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
+
+    # -- batched array API (the TPU-native surface) -----------------------
+
+    def encode_parity(self, data) -> jnp.ndarray:
+        """data (B, k, S) or (k, S) uint8 -> parity (B, m, S) / (m, S)."""
+        return apply_matrix(self.matrix[self.data_shards:], data)
+
+    def encode_batch(self, data) -> jnp.ndarray:
+        """data (..., k, S) -> all shards (..., k+m, S) (data passthrough
+        concatenated with computed parity)."""
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        parity = self.encode_parity(data)
+        return jnp.concatenate([data, parity], axis=-2)
+
+    def verify_batch(self, shards) -> bool:
+        shards = jnp.asarray(shards, dtype=jnp.uint8)
+        parity = self.encode_parity(shards[..., :self.data_shards, :])
+        return bool(jnp.array_equal(parity,
+                                    shards[..., self.data_shards:, :]))
+
+    def decode_matrix_rows(self, present: Sequence[int],
+                           wanted: Sequence[int]) -> np.ndarray:
+        """Host-side: coefficient rows that rebuild ``wanted`` shards from
+        the shards listed in ``present`` (first k of them are used).
+
+        Rows for wanted data shard d come from the inverted submatrix; rows
+        for wanted parity shard p are parity coefficients composed with the
+        decode matrix (so parity can be rebuilt directly from survivors in
+        ONE device pass, without materializing the data shards first —
+        unlike the reference's two-step reconstruct).
+        """
+        present = tuple(present)
+        if len(present) < self.data_shards:
+            raise TooFewShardsError(
+                f"need {self.data_shards} shards, have {len(present)}")
+        chosen = present[:self.data_shards]
+        decode = self._decode_cache.get(chosen)
+        if decode is None:
+            decode = gf256.gf_matrix_invert(self.matrix[list(chosen), :])
+            self._decode_cache[chosen] = decode
+        rows = []
+        for w in wanted:
+            if w < self.data_shards:
+                rows.append(decode[w])
+            else:
+                # parity row in terms of data = matrix[w]; in terms of the
+                # chosen survivors = matrix[w] @ decode.
+                rows.append(gf256.gf_matmul(self.matrix[w][None, :],
+                                            decode)[0])
+        return np.stack(rows, axis=0)
+
+    def reconstruct_batch(self, shards, present: Sequence[int],
+                          wanted: Optional[Sequence[int]] = None):
+        """Rebuild shards on-device.
+
+        ``shards``: (B, len(present), S) uint8 — ONLY the surviving shards,
+        ordered to match ``present``. ``wanted``: which absolute shard ids
+        to produce (default: every missing one). Returns (B, len(wanted), S).
+        """
+        present = list(present)
+        if wanted is None:
+            missing = set(range(self.total_shards)) - set(present)
+            wanted = sorted(missing)
+        if not wanted:
+            raise ValueError("nothing to reconstruct")
+        rows = self.decode_matrix_rows(present, wanted)
+        shards = jnp.asarray(shards, dtype=jnp.uint8)
+        chosen = shards[..., :self.data_shards, :]
+        return apply_matrix(rows, chosen)
+
+    # -- klauspost-style in-place list API (drop-in for the oracle) -------
+
+    def encode(self, shards: list) -> None:
+        if len(shards) != self.total_shards:
+            raise ShardSizeError(
+                f"expected {self.total_shards} shards, got {len(shards)}")
+        sizes = {len(s) for s in shards}
+        if len(sizes) != 1:
+            raise ShardSizeError("shards have inconsistent sizes")
+        data = jnp.stack([jnp.asarray(s, dtype=jnp.uint8)
+                          for s in shards[:self.data_shards]])
+        parity = np.asarray(self.encode_parity(data))
+        for i in range(self.parity_shards):
+            shards[self.data_shards + i][:] = parity[i]
+
+    def verify(self, shards: Sequence) -> bool:
+        arr = jnp.stack([jnp.asarray(s, dtype=jnp.uint8) for s in shards])
+        return self.verify_batch(arr)
+
+    def reconstruct(self, shards: list, data_only: bool = False) -> None:
+        if len(shards) != self.total_shards:
+            raise ShardSizeError(
+                f"expected {self.total_shards} shards, got {len(shards)}")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) == self.total_shards:
+            return
+        wanted = [i for i, s in enumerate(shards) if s is None
+                  and (not data_only or i < self.data_shards)]
+        if not wanted:
+            return
+        surv = jnp.stack([jnp.asarray(shards[i], dtype=jnp.uint8)
+                          for i in present])
+        rebuilt = np.asarray(self.reconstruct_batch(surv[None], present,
+                                                    wanted))[0]
+        for i, buf in zip(wanted, rebuilt):
+            shards[i] = buf
+
+    def reconstruct_data(self, shards: list) -> None:
+        self.reconstruct(shards, data_only=True)

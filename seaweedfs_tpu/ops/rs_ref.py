@@ -126,13 +126,18 @@ class ReferenceEncoder:
         for missing data shards, then (unless data_only) re-encode missing
         parity from the completed data shards.
         """
-        size = self._check_shards(shards, nil_ok=True)
+        if len(shards) != self.total_shards:
+            raise ShardSizeError(
+                f"expected {self.total_shards} shards, got {len(shards)}")
         present = [i for i, s in enumerate(shards) if s is not None]
-        if len(present) == self.total_shards:
-            return
         if len(present) < self.data_shards:
+            # Checked before shard-size validation so total loss reports as
+            # "too few" (klauspost ErrTooFewShards), not a malformed input.
             raise TooFewShardsError(
                 f"need {self.data_shards} shards, have {len(present)}")
+        self._check_shards(shards, nil_ok=True)
+        if len(present) == self.total_shards:
+            return
 
         sub_rows = present[:self.data_shards]
         sub_matrix = self.matrix[sub_rows, :]

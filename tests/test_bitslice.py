@@ -1,0 +1,89 @@
+"""Unit tests for the bitplane packing primitives."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from seaweedfs_tpu.ops import bitslice, gf256
+
+
+def test_transpose32_matches_naive_bit_transpose():
+    rng = np.random.default_rng(0)
+    words = rng.integers(0, 2**32, 32, dtype=np.uint32)
+    out = np.asarray(bitslice.transpose32(jnp.asarray(words)))
+    # Naive: T[i] bit w == A[w] bit i.
+    for i in range(32):
+        for w in range(32):
+            assert (out[i] >> w) & 1 == (words[w] >> i) & 1
+
+
+def test_transpose32_is_involution():
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.integers(0, 2**32, (3, 5, 32), dtype=np.uint32))
+    b = bitslice.transpose32(bitslice.transpose32(a))
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(2)
+    for shape in [(128,), (256,), (2, 3, 512), (1, 1, 128)]:
+        x = jnp.asarray(rng.integers(0, 256, shape, dtype=np.uint8))
+        y = bitslice.unpack(bitslice.pack(x))
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pack_layout_is_bitplanes():
+    """Word i = 8b+j of a group must hold bit j of bytes {4w+b}."""
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 256, 128, dtype=np.uint8)
+    planes = np.asarray(bitslice.pack(jnp.asarray(x)))[0]  # (32,) uint32
+    for b in range(4):
+        for j in range(8):
+            word = planes[8 * b + j]
+            for w in range(32):
+                assert (word >> w) & 1 == (x[4 * w + b] >> j) & 1
+
+
+def test_expand_gf2_matches_gf_mul():
+    rng = np.random.default_rng(4)
+    coefs = rng.integers(0, 256, (3, 5)).astype(np.uint8)
+    mbits = bitslice.expand_gf2(coefs)
+    assert mbits.shape == (24, 40)
+    # Multiply a random byte vector through both representations.
+    for _ in range(50):
+        vec = rng.integers(0, 256, 5).astype(np.uint8)
+        # GF(2^8) direct.
+        direct = np.zeros(3, dtype=np.uint8)
+        for r in range(3):
+            acc = 0
+            for c in range(5):
+                acc ^= gf256.gf_mul(int(coefs[r, c]), int(vec[c]))
+            direct[r] = acc
+        # Bit-matrix: bits of vec -> mbits -> bits of out.
+        vbits = np.array([(int(vec[c]) >> j) & 1
+                          for c in range(5) for j in range(8)], dtype=bool)
+        obits = (mbits.astype(np.int64) @ vbits.astype(np.int64)) % 2
+        via_bits = np.array(
+            [sum(int(obits[8 * r + i]) << i for i in range(8))
+             for r in range(3)], dtype=np.uint8)
+        assert np.array_equal(direct, via_bits)
+
+
+def test_apply_gf_matrix_identity_and_zero():
+    x = jnp.asarray(np.arange(2 * 3 * 128, dtype=np.uint8)
+                    .reshape(2, 3, 128) % 251)
+    ident = np.eye(3, dtype=np.uint8)
+    y = bitslice.apply_gf_matrix(ident, x)
+    assert np.array_equal(np.asarray(x), np.asarray(y))
+    zero = np.zeros((2, 3), dtype=np.uint8)
+    z = bitslice.apply_gf_matrix(zero, x)
+    assert (np.asarray(z) == 0).all()
+
+
+def test_apply_gf_matrix_rejects_bad_shapes():
+    x = jnp.zeros((1, 3, 64), dtype=jnp.uint8)  # 64 not multiple of 128
+    with pytest.raises(ValueError):
+        bitslice.apply_gf_matrix(np.eye(3, dtype=np.uint8), x)
+    with pytest.raises(ValueError):
+        bitslice.apply_gf_matrix(np.eye(4, dtype=np.uint8),
+                                 jnp.zeros((1, 3, 128), dtype=jnp.uint8))

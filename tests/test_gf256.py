@@ -12,7 +12,10 @@ def test_exp_log_roundtrip():
 
 
 def test_mul_matches_carryless_reference():
-    """Check table-driven gf_mul against a bit-by-bit shift/reduce multiply."""
+    """Check table-driven gf_mul against a bit-by-bit shift/reduce multiply.
+
+    Deliberately independent of gf256._carryless_mul so a bug in the
+    module's own bootstrap can't hide from this test."""
     def slow_mul(a, b):
         r = 0
         while b:

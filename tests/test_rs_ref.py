@@ -108,3 +108,9 @@ def test_single_nonzero_byte_propagates_to_all_parities():
     for r in range(4):
         assert parity[r, 5] != 0
         assert (np.delete(parity[r], 5) == 0).all()
+
+
+def test_total_loss_raises_too_few_not_size_error():
+    enc = ReferenceEncoder(4, 2)
+    with pytest.raises(TooFewShardsError):
+        enc.reconstruct([None] * 6)
