@@ -1,0 +1,1 @@
+"""EC pipelines: volume encode/rebuild/decode and the shard read path."""

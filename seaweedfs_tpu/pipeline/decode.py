@@ -1,0 +1,91 @@
+"""ec decode: turn shard files back into a normal .dat/.idx volume.
+
+The volume-server side of `ec.decode` / VolumeEcShardsToVolume (SURVEY.md
+§3, §2 "EC decoder"): what erasure_coding/ec_decoder.go does —
+WriteDatFile from the k data shards (rebuilding them first if lost) and
+WriteIdxFileFromEcIndex, replaying the .ecj delete journal as tombstones.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from ..storage import ec_files, idx as idx_mod, needle as needle_mod
+from ..storage import volume as volume_mod
+from ..storage.types import TOMBSTONE_FILE_SIZE
+from .rebuild import rebuild_ec_files
+from .scheme import DEFAULT_SCHEME, EcScheme
+from .stripe import unstripe
+
+
+class EcDecodeError(RuntimeError):
+    pass
+
+
+def find_dat_file_size(base: str | Path, version: int | None = None) -> int:
+    """Derive the true .dat size from the .ecx (ec_decoder.go
+    FindDatFileSize): the end of the last needle record, or from the .vif
+    if it recorded the size explicitly. ``version`` defaults to the .vif's
+    recorded needle version."""
+    vi = ec_files.VolumeInfo.load(base)
+    if vi.dat_file_size:
+        return vi.dat_file_size
+    if version is None:
+        version = vi.version or 3
+    ecxp = ec_files.ecx_path(base)
+    if not ecxp.exists():
+        raise EcDecodeError(f"{ecxp} does not exist")
+    end = 8  # superblock
+    for e in idx_mod.walk_index_blob(ecxp.read_bytes()):
+        if e.is_deleted:
+            continue
+        rec_end = e.byte_offset + needle_mod.record_size(e.size, version)
+        end = max(end, rec_end)
+    return end
+
+
+def write_dat_file(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME
+                   ) -> int:
+    """Data shards -> <base>.dat (rebuilding missing data shards first).
+    Returns the .dat size."""
+    present = ec_files.present_shards(base, scheme.total_shards)
+    missing_data = [i for i in range(scheme.data_shards)
+                    if i not in present]
+    if missing_data:
+        rebuild_ec_files(base, scheme, wanted=missing_data)
+    dat_size = find_dat_file_size(base)
+    shards = [np.fromfile(ec_files.shard_path(base, i), dtype=np.uint8)
+              for i in range(scheme.data_shards)]
+    dat = unstripe(shards, dat_size, scheme)
+    dat.tofile(volume_mod.dat_path(base))
+    return dat_size
+
+
+def write_idx_file_from_ecx(base: str | Path) -> int:
+    """<base>.ecx (+ .ecj tombstones) -> <base>.idx (ec_decoder.go
+    WriteIdxFileFromEcIndex). Returns entries written."""
+    ecxp = ec_files.ecx_path(base)
+    if not ecxp.exists():
+        raise EcDecodeError(f"{ecxp} does not exist")
+    blob = ecxp.read_bytes()
+    deleted = ec_files.ecj_deleted_set(base)
+    count = 0
+    with open(volume_mod.idx_path(base), "wb") as f:
+        for e in idx_mod.walk_index_blob(blob):
+            f.write(e.to_bytes())
+            count += 1
+        for key in sorted(deleted):
+            f.write(idx_mod.IndexEntry(key, 0,
+                                       TOMBSTONE_FILE_SIZE).to_bytes())
+            count += 1
+    return count
+
+
+def decode_volume(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME
+                  ) -> int:
+    """Full ec.decode: .dat + .idx restored; returns the .dat size."""
+    size = write_dat_file(base, scheme)
+    write_idx_file_from_ecx(base)
+    return size

@@ -1,0 +1,85 @@
+"""ec encode: seal a volume into 14 shard files + .ecx + .vif.
+
+The volume-server side of `ec.encode` (SURVEY.md §3.1): what
+erasure_coding/ec_encoder.go WriteEcFiles + WriteSortedFileFromIdx do,
+restructured for a device: striping produces (R, k, block) row batches,
+each batch is ONE device call computing all parities, and shard files are
+written append-wise per batch so peak host memory is bounded by the batch
+size, not the volume size.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from ..storage import ec_files, idx as idx_mod, volume as volume_mod
+from ..storage import superblock as superblock_mod
+from .scheme import DEFAULT_SCHEME, EcScheme
+from .stripe import iter_row_batches, stripe_rows
+
+#: Default bound on bytes striped into one device batch (input side).
+DEFAULT_MAX_BATCH_BYTES = 256 * 1024 * 1024
+
+
+class EcEncodeError(RuntimeError):
+    pass
+
+
+def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
+                   max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES) -> int:
+    """Generate <base>.ec00..ec<k+m-1> from <base>.dat. Returns the .dat
+    size. Mirrors ec_encoder.go WriteEcFiles (data movement) wrapped
+    around the device codec (parity math)."""
+    datp = volume_mod.dat_path(base)
+    if not datp.exists():
+        raise EcEncodeError(f"{datp} does not exist")
+    # memmap, not fromfile: host residency stays O(batch), not O(volume).
+    dat = np.memmap(datp, dtype=np.uint8, mode="r") \
+        if datp.stat().st_size else np.zeros(0, dtype=np.uint8)
+    outs = [open(ec_files.shard_path(base, i), "wb")
+            for i in range(scheme.total_shards)]
+    try:
+        for rows, _is_large in stripe_rows(dat, scheme):
+            for batch in iter_row_batches(rows, max_batch_bytes):
+                full = np.asarray(scheme.encoder.encode_batch(batch))
+                # (B, k+m, block): append shard s's blocks to its file.
+                per_shard = full.transpose(1, 0, 2)
+                for s in range(scheme.total_shards):
+                    per_shard[s].tofile(outs[s])
+    finally:
+        for f in outs:
+            f.close()
+    return int(dat.size)
+
+
+def write_ecx_file(base: str | Path) -> int:
+    """<base>.idx -> sorted <base>.ecx (WriteSortedFileFromIdx)."""
+    ip = volume_mod.idx_path(base)
+    if not ip.exists():
+        raise EcEncodeError(f"{ip} does not exist")
+    return idx_mod.write_sorted_ecx_from_idx(ip, ec_files.ecx_path(base))
+
+
+def encode_volume(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
+                  max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES,
+                  replication: str = "",
+                  remove_source: bool = False) -> ec_files.VolumeInfo:
+    """Full seal: shards + .ecx + .vif (and optionally drop .dat/.idx the
+    way `ec.encode` deletes the source volume after spreading shards).
+    The .vif records the volume's actual needle version (from the
+    superblock) so readers and decode parse records correctly."""
+    with open(volume_mod.dat_path(base), "rb") as f:
+        version = superblock_mod.SuperBlock.parse(f.read(8)).version
+    dat_size = write_ec_files(base, scheme, max_batch_bytes)
+    write_ecx_file(base)
+    vi = ec_files.VolumeInfo(version=version, replication=replication,
+                             dat_file_size=dat_size)
+    vi.save(base)
+    if remove_source:
+        os.remove(volume_mod.dat_path(base))
+        os.remove(volume_mod.idx_path(base))
+    return vi

@@ -1,0 +1,71 @@
+"""ec rebuild: regenerate missing shard files from survivors.
+
+The volume-server side of `ec.rebuild` (SURVEY.md §3.5): what
+erasure_coding ec_encoder.go RebuildEcFiles does — find which .ec?? files
+exist, and if at least k survive, produce the missing ones. The decode
+matrix composition happens host-side (ops/rs_jax.py), so every missing
+shard — data or parity — comes out of a single device pass per chunk.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.rs_ref import TooFewShardsError
+from ..storage import ec_files
+from .scheme import DEFAULT_SCHEME, EcScheme
+
+#: Chunk of shard-file bytes processed per device call.
+DEFAULT_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+class EcRebuildError(RuntimeError):
+    pass
+
+
+def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
+                     wanted: Optional[Sequence[int]] = None,
+                     chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> list[int]:
+    """Rebuild missing (or explicitly ``wanted``) shard files in place.
+    Returns the list of shard ids written."""
+    total = scheme.total_shards
+    present = ec_files.present_shards(base, total)
+    missing = sorted(set(range(total)) - set(present)) if wanted is None \
+        else sorted(wanted)
+    if not missing:
+        return []
+    overlap = set(missing) & set(present)
+    if wanted is not None and overlap:
+        raise EcRebuildError(f"shards {sorted(overlap)} already exist")
+    if len(present) < scheme.data_shards:
+        raise TooFewShardsError(
+            f"need {scheme.data_shards} surviving shards, "
+            f"have {len(present)}")
+    sizes = {ec_files.shard_path(base, i).stat().st_size for i in present}
+    if len(sizes) != 1:
+        raise EcRebuildError(f"surviving shard sizes differ: {sizes}")
+    size = sizes.pop()
+
+    # Only the first k survivors feed the decode matrix — don't read the
+    # rest from disk at all.
+    present = present[:scheme.data_shards]
+    ins = [open(ec_files.shard_path(base, i), "rb") for i in present]
+    outs = [open(ec_files.shard_path(base, i), "wb") for i in missing]
+    try:
+        pos = 0
+        while pos < size:
+            take = min(chunk_bytes, size - pos)
+            chunk = np.stack([
+                np.frombuffer(f.read(take), dtype=np.uint8) for f in ins])
+            rebuilt = np.asarray(scheme.encoder.reconstruct_batch(
+                chunk[None], present, missing))[0]
+            for row, f in zip(rebuilt, outs):
+                row.tofile(f)
+            pos += take
+    finally:
+        for f in ins + outs:
+            f.close()
+    return missing

@@ -1,0 +1,55 @@
+"""EC scheme: the (k, m, block sizes) tuple threaded through the pipeline.
+
+The reference hardcodes RS(10,4) with 1 GiB / 1 MiB blocks as package
+constants (erasure_coding/ec_encoder.go); BASELINE.json config 4 requires
+parametrized geometries, so the scheme is a value here with the reference's
+numbers as the default.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+from ..ops import rs_jax
+from ..storage import ec_locate
+
+
+@dataclass(frozen=True)
+class EcScheme:
+    data_shards: int = ec_locate.DATA_SHARDS_COUNT
+    parity_shards: int = ec_locate.PARITY_SHARDS_COUNT
+    large_block_size: int = ec_locate.LARGE_BLOCK_SIZE
+    small_block_size: int = ec_locate.SMALL_BLOCK_SIZE
+
+    def __post_init__(self):
+        if self.data_shards <= 0 or self.parity_shards <= 0:
+            raise ValueError("shard counts must be positive")
+        if self.large_block_size % self.small_block_size:
+            raise ValueError("large block must be a multiple of small block")
+
+    @property
+    def total_shards(self) -> int:
+        return self.data_shards + self.parity_shards
+
+    @cached_property
+    def encoder(self) -> rs_jax.Encoder:
+        return rs_jax.Encoder(self.data_shards, self.parity_shards)
+
+    # Convenience pass-throughs to the interval math with this geometry.
+    def locate(self, offset: int, size: int, dat_size: int):
+        return ec_locate.locate_data(
+            offset, size, dat_size, self.data_shards,
+            self.large_block_size, self.small_block_size)
+
+    def shard_file_size(self, dat_size: int) -> int:
+        return ec_locate.shard_file_size(
+            dat_size, self.data_shards, self.large_block_size,
+            self.small_block_size)
+
+    def large_rows_count(self, dat_size: int) -> int:
+        return ec_locate.large_rows_count(
+            dat_size, self.data_shards, self.large_block_size)
+
+
+DEFAULT_SCHEME = EcScheme()

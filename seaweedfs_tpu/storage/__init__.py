@@ -1,0 +1,8 @@
+"""On-disk formats: needles, volumes, indexes, EC shards.
+
+Bit-compatible with the reference's weed/storage layouts (SURVEY.md §2, §5);
+these files are the interop surface with real SeaweedFS clusters. The
+reference mount was empty at survey time, so layouts follow the surveyed
+upstream formats — every module docstring records exactly which file it
+mirrors.
+"""

@@ -1,0 +1,67 @@
+"""CRC32-C (Castagnoli) needle checksums.
+
+The reference checksums needle data with ``crc32.MakeTable(crc32.
+Castagnoli)`` (weed/storage/needle/crc.go; SURVEY.md §2 "Needle codec").
+Python's zlib only exposes the IEEE polynomial, so this is a table-driven
+CRC32-C: a slice-by-8 numpy implementation for bulk data (the tables are
+applied with vectorized gathers host-side) with the classic byte loop as
+the reference path for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+#: Castagnoli polynomial, reversed representation.
+POLY = 0x82F63B78
+
+
+@functools.lru_cache(maxsize=1)
+def _tables() -> np.ndarray:
+    """Slice-by-8 tables: t[0] is the classic byte table; t[j] advances a
+    byte through j additional zero bytes."""
+    t = np.zeros((8, 256), dtype=np.uint32)
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (POLY if crc & 1 else 0)
+        t[0, i] = crc
+    for j in range(1, 8):
+        for i in range(256):
+            t[j, i] = (t[j - 1, i] >> 8) ^ t[0, t[j - 1, i] & 0xFF]
+    return t
+
+
+def crc32c_slow(data: bytes, crc: int = 0) -> int:
+    """Bit-exact reference: one byte at a time."""
+    table = _tables()[0]
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ int(table[(crc ^ b) & 0xFF])
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+    """Slice-by-8 CRC32-C — same result as the byte loop, ~8x fewer Python
+    iterations. Correctness path; the native module (seaweedfs_tpu/native)
+    supplies the fast bulk implementation."""
+    buf = np.frombuffer(data, dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else data.astype(np.uint8)
+    t = _tables()
+    crc ^= 0xFFFFFFFF
+    n8 = buf.size // 8
+    if n8:
+        words = buf[:n8 * 8].reshape(n8, 8)
+        for row in range(n8):
+            w = words[row]
+            c0 = crc ^ (int(w[0]) | (int(w[1]) << 8)
+                        | (int(w[2]) << 16) | (int(w[3]) << 24))
+            crc = (int(t[7, c0 & 0xFF]) ^ int(t[6, (c0 >> 8) & 0xFF])
+                   ^ int(t[5, (c0 >> 16) & 0xFF]) ^ int(t[4, c0 >> 24])
+                   ^ int(t[3, w[4]]) ^ int(t[2, w[5]])
+                   ^ int(t[1, w[6]]) ^ int(t[0, w[7]]))
+    for b in buf[n8 * 8:]:
+        crc = (crc >> 8) ^ int(t[0, (crc ^ int(b)) & 0xFF])
+    return crc ^ 0xFFFFFFFF

@@ -1,0 +1,103 @@
+r"""Interval math: map a (.dat offset, size) to EC shard intervals.
+
+Mirrors weed/storage/erasure_coding/ec_locate.go (SURVEY.md §2 "EC interval
+math", §5 long-context note): a sealed volume is striped row-major across
+the k data shards — first in LARGE blocks (1 GiB) while more than one full
+large row of data remains, then in SMALL blocks (1 MiB) for the tail (the
+last small row zero-padded). Any byte range of the logical .dat maps
+deterministically to a list of (shard id, offset inside that shard, size)
+intervals; this is the sequence-sharding analog and must stay bit-identical
+for shard files to interoperate.
+
+Layout (k = DataShardsCount):
+
+    dat offset axis:  [L0 L1 ... L(k-1)] [L0' ...] ... | [S0 S1 ... S(k-1)] ...
+                       \---- large row ----/              \---- small row ---/
+    shard s file:     [row0 Ls] [row1 Ls'] ... | [small blocks of s] ...
+
+Shard-local offset of large row r = r * large. Shard-local offset of small
+row q = large_rows * large + q * small.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DATA_SHARDS_COUNT = 10
+PARITY_SHARDS_COUNT = 4
+TOTAL_SHARDS_COUNT = DATA_SHARDS_COUNT + PARITY_SHARDS_COUNT
+
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1 GiB
+SMALL_BLOCK_SIZE = 1024 * 1024         # 1 MiB
+
+
+@dataclass(frozen=True)
+class Interval:
+    """One contiguous piece of a needle inside one data shard."""
+
+    shard_id: int          # data shard 0..k-1
+    inner_block_offset: int  # byte offset inside the shard FILE
+    size: int
+    is_large_block: bool
+    block_index: int       # row index within the large or small region
+
+
+def large_rows_count(dat_size: int, k: int = DATA_SHARDS_COUNT,
+                     large: int = LARGE_BLOCK_SIZE) -> int:
+    """Number of full large rows. Matches the reference's processing loop,
+    which consumes large rows while MORE than one full row remains (an
+    exactly-one-row file is encoded entirely in small blocks)."""
+    rows = 0
+    remaining = dat_size
+    while remaining > large * k:
+        rows += 1
+        remaining -= large * k
+    return rows
+
+
+def shard_file_size(dat_size: int, k: int = DATA_SHARDS_COUNT,
+                    large: int = LARGE_BLOCK_SIZE,
+                    small: int = SMALL_BLOCK_SIZE) -> int:
+    """Size of each of the k data shard files (parity files match): full
+    large rows plus ceil-padded small rows."""
+    rows = large_rows_count(dat_size, k, large)
+    remaining = dat_size - rows * large * k
+    small_rows = -(-remaining // (small * k)) if remaining else 0
+    return rows * large + small_rows * small
+
+
+def locate_data(offset: int, size: int, dat_size: int,
+                k: int = DATA_SHARDS_COUNT,
+                large: int = LARGE_BLOCK_SIZE,
+                small: int = SMALL_BLOCK_SIZE) -> list[Interval]:
+    """Split the logical range [offset, offset+size) into shard intervals
+    (ec_locate.go LocateData)."""
+    if offset < 0 or size < 0:
+        raise ValueError("negative offset/size")
+    if offset + size > dat_size:
+        raise ValueError(
+            f"range [{offset}, {offset + size}) beyond dat size {dat_size}")
+    rows = large_rows_count(dat_size, k, large)
+    large_region = rows * large * k
+    out: list[Interval] = []
+    pos, end = offset, offset + size
+    while pos < end:
+        if pos < large_region:
+            block, is_large = large, True
+            region_off = pos
+            base_shard_off = 0
+        else:
+            block, is_large = small, False
+            region_off = pos - large_region
+            base_shard_off = rows * large
+        row, row_off = divmod(region_off, block * k)
+        shard, inner = divmod(row_off, block)
+        take = min(end - pos, block - inner)
+        out.append(Interval(
+            shard_id=shard,
+            inner_block_offset=base_shard_off + row * block + inner,
+            size=take,
+            is_large_block=is_large,
+            block_index=row))
+        pos += take
+    return out

@@ -1,0 +1,167 @@
+"""Needle index files (.idx journal, .ecx sorted index) and the in-RAM map.
+
+Mirrors weed/storage/idx/ + weed/storage/needle_map/ (SURVEY.md §2 "Needle
+map"): the .idx file is an append-only journal of 16-byte big-endian
+entries (key u64, offset u32 in 8-byte units, size u32); later entries for
+a key supersede earlier ones; size == 0xFFFFFFFF (tombstone) records a
+delete. The .ecx file is the same entry format but sorted by key and
+deduplicated — the immutable index an EC volume serves lookups from
+(ec_encoder.go WriteSortedFileFromIdx).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+from .types import (NEEDLE_MAP_ENTRY_SIZE, TOMBSTONE_FILE_SIZE,
+                    actual_offset, is_deleted_size)
+
+_ENTRY = struct.Struct(">QII")
+
+
+@dataclass(frozen=True)
+class IndexEntry:
+    key: int
+    offset_units: int  # multiply by 8 for the byte offset
+    size: int
+
+    @property
+    def byte_offset(self) -> int:
+        return actual_offset(self.offset_units)
+
+    @property
+    def is_deleted(self) -> bool:
+        return is_deleted_size(self.size)
+
+    def to_bytes(self) -> bytes:
+        return _ENTRY.pack(self.key, self.offset_units, self.size)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes, off: int = 0) -> "IndexEntry":
+        key, offset_units, size = _ENTRY.unpack_from(buf, off)
+        return cls(key, offset_units, size)
+
+
+def walk_index_blob(blob: bytes) -> Iterator[IndexEntry]:
+    """Yield entries from raw .idx/.ecx bytes (idx.WalkIndexFile)."""
+    if len(blob) % NEEDLE_MAP_ENTRY_SIZE:
+        raise ValueError(
+            f"index length {len(blob)} not a multiple of "
+            f"{NEEDLE_MAP_ENTRY_SIZE}")
+    for off in range(0, len(blob), NEEDLE_MAP_ENTRY_SIZE):
+        yield IndexEntry.from_bytes(blob, off)
+
+
+def walk_index_file(path) -> Iterator[IndexEntry]:
+    with open(path, "rb") as f:
+        yield from walk_index_blob(f.read())
+
+
+class CompactMap:
+    """In-RAM needle map: key -> live IndexEntry (needle_map/compact_map.go
+    in spirit; a dict here — the Go version's segmented arrays exist to
+    shave GC pressure, which Python doesn't benefit from)."""
+
+    def __init__(self) -> None:
+        self._m: dict[int, IndexEntry] = {}
+        self.file_count = 0
+        self.deleted_count = 0
+        self.deleted_bytes = 0
+        self.max_offset_units = 0
+
+    def set(self, key: int, offset_units: int, size: int) -> None:
+        old = self._m.get(key)
+        if old is not None and not old.is_deleted:
+            self.deleted_count += 1
+            self.deleted_bytes += old.size
+        self._m[key] = IndexEntry(key, offset_units, size)
+        self.file_count += 1
+        self.max_offset_units = max(self.max_offset_units, offset_units)
+
+    def delete(self, key: int) -> bool:
+        old = self._m.get(key)
+        if old is None or old.is_deleted:
+            return False
+        self.deleted_count += 1
+        self.deleted_bytes += old.size
+        self._m[key] = IndexEntry(key, old.offset_units,
+                                  TOMBSTONE_FILE_SIZE)
+        return True
+
+    def get(self, key: int) -> Optional[IndexEntry]:
+        e = self._m.get(key)
+        if e is None or e.is_deleted:
+            return None
+        return e
+
+    def __len__(self) -> int:
+        return sum(1 for e in self._m.values() if not e.is_deleted)
+
+    def items(self) -> Iterator[IndexEntry]:
+        return iter(self._m.values())
+
+    def live_entries(self) -> list[IndexEntry]:
+        return sorted((e for e in self._m.values() if not e.is_deleted),
+                      key=lambda e: e.key)
+
+    @classmethod
+    def load_from_idx(cls, path) -> "CompactMap":
+        m = cls()
+        for e in walk_index_file(path):
+            if e.is_deleted:
+                m.delete(e.key)
+            else:
+                m.set(e.key, e.offset_units, e.size)
+        return m
+
+
+def write_sorted_ecx_from_idx(idx_path, ecx_path) -> int:
+    """.idx journal -> sorted, deduplicated .ecx (ec_encoder.go
+    WriteSortedFileFromIdx). Returns the number of live entries written.
+
+    Entries deleted before sealing never reach the .ecx; deletes after
+    sealing go to the .ecj journal instead (ec_volume_delete.go).
+    """
+    m = CompactMap.load_from_idx(idx_path)
+    live = m.live_entries()
+    with open(ecx_path, "wb") as f:
+        for e in live:
+            f.write(e.to_bytes())
+    return len(live)
+
+
+def search_ecx_blob(blob: bytes, key: int) -> Optional[IndexEntry]:
+    """Binary-search a sorted .ecx blob for ``key`` (ec_volume.go
+    SearchNeedleFromSortedIndex)."""
+    lo, hi = 0, len(blob) // NEEDLE_MAP_ENTRY_SIZE
+    while lo < hi:
+        mid = (lo + hi) // 2
+        e = IndexEntry.from_bytes(blob, mid * NEEDLE_MAP_ENTRY_SIZE)
+        if e.key == key:
+            return e
+        if e.key < key:
+            lo = mid + 1
+        else:
+            hi = mid
+    return None
+
+
+def search_ecx_file(path, key: int) -> Optional[IndexEntry]:
+    """Binary-search the .ecx file on disk without loading it fully."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        n = f.tell() // NEEDLE_MAP_ENTRY_SIZE
+        lo, hi = 0, n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            f.seek(mid * NEEDLE_MAP_ENTRY_SIZE)
+            e = IndexEntry.from_bytes(f.read(NEEDLE_MAP_ENTRY_SIZE))
+            if e.key == key:
+                return e
+            if e.key < key:
+                lo = mid + 1
+            else:
+                hi = mid
+    return None

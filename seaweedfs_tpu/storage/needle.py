@@ -1,0 +1,213 @@
+"""Needle record codec — the unit of storage inside a volume.
+
+Mirrors weed/storage/needle/ (needle.go, needle_read_write.go; SURVEY.md §2
+"Needle codec"): a needle on disk is
+
+    header:  Cookie u32 | NeedleId u64 | Size u32          (16 B, big-endian)
+    body:    DataSize u32 | Data | Flags u8 | [optional fields by flag]
+    tail:    Checksum u32 (CRC32-C of Data)
+             [version 3 only: AppendAtNs u64]
+    padding: zeros to the next 8-byte boundary
+
+``Size`` in the header counts the body only. Optional fields (each gated by
+a flag bit): Name (u8 len + bytes), Mime (u8 len + bytes), LastModified
+(5 bytes, big-endian seconds), Ttl (2 bytes: count + unit), Pairs (u16 len
++ bytes). Version 1 (body = raw data, no DataSize/Flags) is read-supported
+for old volumes; writes always use the requested version (default 3).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field
+
+from . import crc as crc_mod
+from .types import (NEEDLE_CHECKSUM_SIZE, NEEDLE_HEADER_SIZE,
+                    NEEDLE_PADDING_SIZE, TIMESTAMP_SIZE)
+
+# Flag bits (needle.go).
+FLAG_IS_COMPRESSED = 0x01
+FLAG_HAS_NAME = 0x02
+FLAG_HAS_MIME = 0x04
+FLAG_HAS_LAST_MODIFIED = 0x08
+FLAG_HAS_TTL = 0x10
+FLAG_HAS_PAIRS = 0x20
+FLAG_IS_DELETE = 0x40
+FLAG_IS_CHUNK_MANIFEST = 0x80
+
+LAST_MODIFIED_BYTES = 5
+TTL_BYTES = 2
+
+_HEADER = struct.Struct(">IQI")
+
+
+class NeedleError(ValueError):
+    pass
+
+
+@dataclass
+class Needle:
+    """In-memory needle; ``id`` is the 64-bit needle key, ``cookie`` the
+    32-bit anti-guessing token embedded in the public file id."""
+
+    cookie: int
+    id: int
+    data: bytes = b""
+    flags: int = 0
+    name: bytes = b""
+    mime: bytes = b""
+    last_modified: int = 0  # unix seconds, 5 bytes on disk
+    ttl: bytes = b"\x00\x00"  # (count, unit) encoded
+    pairs: bytes = b""
+    append_at_ns: int = 0  # version 3 timestamp
+    checksum: int | None = None  # filled on parse; None -> computed
+
+    # -- body assembly ----------------------------------------------------
+
+    def _effective_flags(self) -> int:
+        f = self.flags
+        if self.name:
+            f |= FLAG_HAS_NAME
+        if self.mime:
+            f |= FLAG_HAS_MIME
+        if self.last_modified:
+            f |= FLAG_HAS_LAST_MODIFIED
+        if self.ttl != b"\x00\x00":
+            f |= FLAG_HAS_TTL
+        if self.pairs:
+            f |= FLAG_HAS_PAIRS
+        return f
+
+    def body_bytes(self, version: int = 3) -> bytes:
+        if version == 1:
+            return self.data
+        f = self._effective_flags()
+        parts = [struct.pack(">I", len(self.data)), self.data,
+                 bytes([f & 0xFF])]
+        if f & FLAG_HAS_NAME:
+            if len(self.name) > 255:
+                raise NeedleError("name longer than 255 bytes")
+            parts += [bytes([len(self.name)]), self.name]
+        if f & FLAG_HAS_MIME:
+            if len(self.mime) > 255:
+                raise NeedleError("mime longer than 255 bytes")
+            parts += [bytes([len(self.mime)]), self.mime]
+        if f & FLAG_HAS_LAST_MODIFIED:
+            parts.append(self.last_modified.to_bytes(LAST_MODIFIED_BYTES,
+                                                     "big"))
+        if f & FLAG_HAS_TTL:
+            parts.append(self.ttl)
+        if f & FLAG_HAS_PAIRS:
+            parts += [struct.pack(">H", len(self.pairs)), self.pairs]
+        return b"".join(parts)
+
+    def to_bytes(self, version: int = 3) -> bytes:
+        """Full on-disk record including header, checksum, timestamp and
+        padding — ready to append to a .dat file."""
+        body = self.body_bytes(version)
+        checksum = self.checksum if self.checksum is not None \
+            else crc_mod.crc32c(self.data)
+        parts = [_HEADER.pack(self.cookie, self.id, len(body)), body,
+                 struct.pack(">I", checksum)]
+        if version == 3:
+            ns = self.append_at_ns or time.time_ns()
+            parts.append(struct.pack(">Q", ns))
+        raw = b"".join(parts)
+        pad = (-len(raw)) % NEEDLE_PADDING_SIZE
+        return raw + b"\x00" * pad
+
+    def disk_size(self, version: int = 3) -> int:
+        return len(self.to_bytes(version))
+
+    # -- parsing ----------------------------------------------------------
+
+    @classmethod
+    def parse(cls, buf: bytes, version: int = 3,
+              verify_checksum: bool = True) -> "Needle":
+        """Parse one full needle record (header + body + tail)."""
+        if len(buf) < NEEDLE_HEADER_SIZE:
+            raise NeedleError("short needle header")
+        cookie, nid, size = _HEADER.unpack_from(buf, 0)
+        body = buf[NEEDLE_HEADER_SIZE:NEEDLE_HEADER_SIZE + size]
+        if len(body) != size:
+            raise NeedleError("short needle body")
+        n = cls(cookie=cookie, id=nid)
+        pos = NEEDLE_HEADER_SIZE + size
+        if version == 1:
+            n.data = bytes(body)
+        else:
+            if size < 5:
+                raise NeedleError("needle body too short for v2/v3")
+            data_size = struct.unpack_from(">I", body, 0)[0]
+            if 4 + data_size + 1 > size:
+                raise NeedleError("data size exceeds body")
+            n.data = bytes(body[4:4 + data_size])
+            off = 4 + data_size
+            f = body[off]
+            off += 1
+            n.flags = f
+            def _need(n_bytes: int) -> None:
+                # Explicit bounds check: Python slices never raise on
+                # truncation, so a corrupt body would otherwise parse
+                # silently with empty/zero fields.
+                if off + n_bytes > size:
+                    raise NeedleError("truncated optional fields")
+
+            if f & FLAG_HAS_NAME:
+                _need(1)
+                ln = body[off]
+                _need(1 + ln)
+                n.name = bytes(body[off + 1:off + 1 + ln])
+                off += 1 + ln
+            if f & FLAG_HAS_MIME:
+                _need(1)
+                ln = body[off]
+                _need(1 + ln)
+                n.mime = bytes(body[off + 1:off + 1 + ln])
+                off += 1 + ln
+            if f & FLAG_HAS_LAST_MODIFIED:
+                _need(LAST_MODIFIED_BYTES)
+                n.last_modified = int.from_bytes(
+                    body[off:off + LAST_MODIFIED_BYTES], "big")
+                off += LAST_MODIFIED_BYTES
+            if f & FLAG_HAS_TTL:
+                _need(TTL_BYTES)
+                n.ttl = bytes(body[off:off + TTL_BYTES])
+                off += TTL_BYTES
+            if f & FLAG_HAS_PAIRS:
+                _need(2)
+                ln = struct.unpack_from(">H", body, off)[0]
+                _need(2 + ln)
+                n.pairs = bytes(body[off + 2:off + 2 + ln])
+                off += 2 + ln
+        if len(buf) < pos + NEEDLE_CHECKSUM_SIZE:
+            raise NeedleError("missing checksum")
+        n.checksum = struct.unpack_from(">I", buf, pos)[0]
+        pos += NEEDLE_CHECKSUM_SIZE
+        if version == 3:
+            if len(buf) < pos + TIMESTAMP_SIZE:
+                raise NeedleError("missing v3 timestamp")
+            n.append_at_ns = struct.unpack_from(">Q", buf, pos)[0]
+        if verify_checksum and version != 1:
+            actual = crc_mod.crc32c(n.data)
+            if actual != n.checksum:
+                raise NeedleError(
+                    f"crc mismatch: stored {n.checksum:#x}, "
+                    f"computed {actual:#x}")
+        return n
+
+
+def parse_header(buf: bytes) -> tuple[int, int, int]:
+    """(cookie, id, size) from the first 16 bytes."""
+    if len(buf) < NEEDLE_HEADER_SIZE:
+        raise NeedleError("short needle header")
+    return _HEADER.unpack_from(buf, 0)
+
+
+def record_size(body_size: int, version: int = 3) -> int:
+    """On-disk record length for a given header ``Size`` value."""
+    raw = NEEDLE_HEADER_SIZE + body_size + NEEDLE_CHECKSUM_SIZE
+    if version == 3:
+        raw += TIMESTAMP_SIZE
+    return raw + ((-raw) % NEEDLE_PADDING_SIZE)

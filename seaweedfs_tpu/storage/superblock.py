@@ -1,0 +1,121 @@
+"""Volume superblock — the first 8 bytes of every .dat file.
+
+Mirrors weed/storage/super_block/ (super_block.go, replica_placement.go;
+SURVEY.md §2 "Store / Volume engine", §5 checkpoint artifacts):
+
+    byte 0   version (3 current)
+    byte 1   replica placement, encoded DC*100 + rack*10 + sameRack
+    byte 2-3 TTL (count u8, unit u8)
+    byte 4-5 compact revision, big-endian u16
+    byte 6-7 extra-block size, big-endian u16 (followed by that many bytes)
+
+The TTL unit byte: 0 empty, 1 minute, 2 hour, 3 day, 4 week, 5 month,
+6 year (volume_ttl.go).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+SUPER_BLOCK_SIZE = 8
+CURRENT_VERSION = 3
+
+_TTL_UNITS = {"": 0, "m": 1, "h": 2, "d": 3, "w": 4, "M": 5, "y": 6}
+_TTL_UNITS_REV = {v: k for k, v in _TTL_UNITS.items()}
+
+
+@dataclass(frozen=True)
+class ReplicaPlacement:
+    """Replica placement code ``<dc><rack><sameRack>`` e.g. "001", "110"."""
+
+    same_rack: int = 0
+    diff_rack: int = 0
+    diff_dc: int = 0
+
+    @classmethod
+    def parse(cls, s: str) -> "ReplicaPlacement":
+        if len(s) != 3 or not s.isdigit():
+            raise ValueError(f"bad replica placement {s!r}")
+        return cls(diff_dc=int(s[0]), diff_rack=int(s[1]),
+                   same_rack=int(s[2]))
+
+    @classmethod
+    def from_byte(cls, b: int) -> "ReplicaPlacement":
+        return cls(diff_dc=b // 100, diff_rack=(b // 10) % 10,
+                   same_rack=b % 10)
+
+    def to_byte(self) -> int:
+        return self.diff_dc * 100 + self.diff_rack * 10 + self.same_rack
+
+    def copy_count(self) -> int:
+        return self.diff_dc + self.diff_rack + self.same_rack + 1
+
+    def __str__(self) -> str:
+        return f"{self.diff_dc}{self.diff_rack}{self.same_rack}"
+
+
+@dataclass(frozen=True)
+class Ttl:
+    """Volume TTL: count + unit char, e.g. "3d" (volume_ttl.go)."""
+
+    count: int = 0
+    unit: str = ""
+
+    @classmethod
+    def parse(cls, s: str) -> "Ttl":
+        if not s or s == "0":
+            return cls()
+        unit = s[-1] if s[-1] in _TTL_UNITS else "m"
+        num = s[:-1] if s[-1] in _TTL_UNITS else s
+        return cls(count=int(num), unit=unit)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Ttl":
+        if len(b) != 2:
+            raise ValueError("ttl must be 2 bytes")
+        if b[0] == 0:
+            return cls()
+        return cls(count=b[0], unit=_TTL_UNITS_REV.get(b[1], ""))
+
+    def to_bytes(self) -> bytes:
+        if self.count == 0:
+            return b"\x00\x00"
+        return bytes([self.count & 0xFF, _TTL_UNITS.get(self.unit, 0)])
+
+    def __str__(self) -> str:
+        return "" if self.count == 0 else f"{self.count}{self.unit}"
+
+
+@dataclass
+class SuperBlock:
+    version: int = CURRENT_VERSION
+    replica_placement: ReplicaPlacement = field(
+        default_factory=ReplicaPlacement)
+    ttl: Ttl = field(default_factory=Ttl)
+    compact_revision: int = 0
+    extra: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        head = struct.pack(
+            ">BB2sHH", self.version, self.replica_placement.to_byte(),
+            self.ttl.to_bytes(), self.compact_revision, len(self.extra))
+        return head + self.extra
+
+    @classmethod
+    def parse(cls, buf: bytes) -> "SuperBlock":
+        if len(buf) < SUPER_BLOCK_SIZE:
+            raise ValueError("short superblock")
+        version, rp, ttl_b, rev, extra_len = struct.unpack_from(
+            ">BB2sHH", buf, 0)
+        extra = bytes(buf[SUPER_BLOCK_SIZE:SUPER_BLOCK_SIZE + extra_len])
+        if len(extra) != extra_len:
+            raise ValueError("short superblock extra block")
+        return cls(version=version,
+                   replica_placement=ReplicaPlacement.from_byte(rp),
+                   ttl=Ttl.from_bytes(ttl_b), compact_revision=rev,
+                   extra=extra)
+
+    @property
+    def block_size(self) -> int:
+        return SUPER_BLOCK_SIZE + len(self.extra)

@@ -1,0 +1,74 @@
+"""Core storage constants and id types.
+
+Mirrors weed/storage/types/ (needle_types.go, offset.go; SURVEY.md §2
+"Needle map" row): 16-byte index entries, 8-byte offset units (giving the
+32 GB max volume size), tombstone size marker, and the
+``<vid>,<id-hex><cookie-hex>`` file-id string format used across every
+layer of the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Sizes in bytes (types/needle_types.go).
+COOKIE_SIZE = 4
+NEEDLE_ID_SIZE = 8
+SIZE_SIZE = 4
+OFFSET_SIZE = 4
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_CHECKSUM_SIZE = 4
+TIMESTAMP_SIZE = 8  # version-3 appended nanosecond timestamp
+
+#: Needle records are padded so every offset is a multiple of 8; offsets in
+#: the index are stored in these units, extending 32-bit offsets to 32 GB.
+NEEDLE_PADDING_SIZE = 8
+
+#: Size value marking a deleted needle in .idx entries (math.MaxUint32).
+TOMBSTONE_FILE_SIZE = 0xFFFFFFFF
+
+#: Maximum volume size addressable by 4-byte offsets in 8-byte units.
+MAX_POSSIBLE_VOLUME_SIZE = (2**32) * NEEDLE_PADDING_SIZE  # 32 GiB
+
+
+def actual_offset(offset_units: int) -> int:
+    """Index offset field -> byte offset in the .dat file."""
+    return offset_units * NEEDLE_PADDING_SIZE
+
+
+def to_offset_units(byte_offset: int) -> int:
+    if byte_offset % NEEDLE_PADDING_SIZE:
+        raise ValueError(f"offset {byte_offset} not 8-byte aligned")
+    return byte_offset // NEEDLE_PADDING_SIZE
+
+
+def is_deleted_size(size: int) -> bool:
+    return size == TOMBSTONE_FILE_SIZE
+
+
+@dataclass(frozen=True)
+class FileId:
+    """A full file id ``<volume>,<key-hex><cookie-hex>`` (weed/storage/
+    needle/file_id.go). The hex key is written without leading zeros; the
+    cookie is always exactly 8 hex chars appended to it."""
+
+    volume_id: int
+    key: int
+    cookie: int
+
+    def __str__(self) -> str:
+        return f"{self.volume_id},{self.key:x}{self.cookie:08x}"
+
+    @classmethod
+    def parse(cls, fid: str) -> "FileId":
+        try:
+            vid_str, rest = fid.split(",", 1)
+            volume_id = int(vid_str)
+            if len(rest) <= 8:
+                raise ValueError(fid)
+            key = int(rest[:-8], 16)
+            cookie = int(rest[-8:], 16)
+        except (ValueError, IndexError) as e:
+            raise ValueError(f"malformed file id {fid!r}") from e
+        return cls(volume_id=volume_id, key=key, cookie=cookie)

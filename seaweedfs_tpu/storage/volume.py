@@ -1,0 +1,173 @@
+"""Append-only volume files: .dat + .idx lifecycle.
+
+Mirrors weed/storage/ (volume.go, volume_read_write.go, volume_loading.go;
+SURVEY.md §2 "Store / Volume engine"): a volume is an append-only .dat file
+opened with an 8-byte superblock, needle records appended 8-byte aligned,
+and a parallel .idx journal recording (key, offset, size) per write plus
+tombstones per delete. Loading replays the .idx into a CompactMap; reads
+seek straight to the needle (the Haystack O(1)-seek property).
+
+Also hosts the synthetic volume generator used by tests and benchmarks
+(the reference's ec_test.go builds its fixture volume the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from . import needle as needle_mod
+from .idx import CompactMap, IndexEntry
+from .superblock import SuperBlock
+from .types import (NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE,
+                    to_offset_units)
+
+
+class VolumeError(RuntimeError):
+    pass
+
+
+def dat_path(base: str | Path) -> Path:
+    return Path(str(base) + ".dat")
+
+
+def idx_path(base: str | Path) -> Path:
+    return Path(str(base) + ".idx")
+
+
+class Volume:
+    """A single writable/readable volume addressed by its base path
+    (``<dir>/<collection_>?<vid>`` without extension)."""
+
+    def __init__(self, base: str | Path, volume_id: int = 0,
+                 super_block: Optional[SuperBlock] = None):
+        self.base = Path(base)
+        self.volume_id = volume_id
+        self.super_block = super_block or SuperBlock()
+        self.nm = CompactMap()
+        self._dat = None
+        self._idx = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def create(self) -> "Volume":
+        if dat_path(self.base).exists():
+            raise VolumeError(f"{dat_path(self.base)} already exists")
+        self._dat = open(dat_path(self.base), "w+b")
+        self._dat.write(self.super_block.to_bytes())
+        self._idx = open(idx_path(self.base), "w+b")
+        return self
+
+    def load(self) -> "Volume":
+        p = dat_path(self.base)
+        if not p.exists():
+            raise VolumeError(f"{p} does not exist")
+        self._dat = open(p, "r+b")
+        head = self._dat.read(8)
+        if len(head) < 8:
+            raise VolumeError(f"{p} shorter than a superblock")
+        extra_len = struct.unpack_from(">H", head, 6)[0]
+        self.super_block = SuperBlock.parse(head + self._dat.read(extra_len))
+        ip = idx_path(self.base)
+        self._idx = open(ip, "a+b") if ip.exists() else open(ip, "w+b")
+        self.nm = CompactMap.load_from_idx(ip)
+        self._dat.seek(0, 2)
+        return self
+
+    def close(self) -> None:
+        for f in (self._dat, self._idx):
+            if f is not None:
+                f.close()
+        self._dat = self._idx = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- data plane -------------------------------------------------------
+
+    def write_needle(self, n: needle_mod.Needle) -> int:
+        """Append; returns the byte offset of the record. Mirrors
+        Volume.writeNeedle: append to .dat, then journal to .idx."""
+        if self._dat is None:
+            raise VolumeError("volume not open")
+        self._dat.seek(0, 2)
+        offset = self._dat.tell()
+        if offset % NEEDLE_PADDING_SIZE:
+            pad = (-offset) % NEEDLE_PADDING_SIZE
+            self._dat.write(b"\x00" * pad)
+            offset += pad
+        rec = n.to_bytes(self.super_block.version)
+        body_size = needle_mod.parse_header(rec)[2]
+        self._dat.write(rec)
+        units = to_offset_units(offset)
+        self.nm.set(n.id, units, body_size)
+        self._idx.write(IndexEntry(n.id, units, body_size).to_bytes())
+        return offset
+
+    def read_needle(self, key: int, cookie: Optional[int] = None
+                    ) -> needle_mod.Needle:
+        entry = self.nm.get(key)
+        if entry is None:
+            raise KeyError(f"needle {key} not found")
+        if self._dat is None:
+            raise VolumeError("volume not open")
+        self._dat.seek(entry.byte_offset)
+        rec = self._dat.read(
+            needle_mod.record_size(entry.size, self.super_block.version))
+        n = needle_mod.Needle.parse(rec, self.super_block.version)
+        if n.id != key:
+            raise VolumeError(
+                f"index/offset mismatch: wanted {key}, found {n.id}")
+        if cookie is not None and n.cookie != cookie:
+            raise VolumeError("cookie mismatch")
+        return n
+
+    def delete_needle(self, key: int) -> bool:
+        if not self.nm.delete(key):
+            return False
+        self._idx.write(
+            IndexEntry(key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
+        return True
+
+    def sync(self) -> None:
+        for f in (self._dat, self._idx):
+            if f is not None:
+                f.flush()
+                os.fsync(f.fileno())
+
+    @property
+    def dat_size(self) -> int:
+        self._dat.seek(0, 2)
+        return self._dat.tell()
+
+    def content_size(self) -> int:
+        return self.dat_size
+
+
+def generate_synthetic_volume(base: str | Path, volume_id: int,
+                              n_needles: int, avg_size: int = 1024,
+                              seed: int = 0,
+                              version: int = 3) -> "Volume":
+    """Create a .dat/.idx pair full of random needles (the ec_test.go
+    fixture pattern). Needle sizes jitter around ``avg_size``; ids are
+    1..n; cookies are random. Returns the still-open Volume."""
+    rng = np.random.default_rng(seed)
+    sb = SuperBlock(version=version)
+    vol = Volume(base, volume_id, sb).create()
+    for i in range(1, n_needles + 1):
+        size = max(1, int(rng.integers(avg_size // 2, avg_size * 3 // 2)))
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        n = needle_mod.Needle(
+            cookie=int(rng.integers(0, 2**32)), id=i, data=data,
+            append_at_ns=int(1_700_000_000_000_000_000 + i))
+        vol.write_needle(n)
+    vol.sync()
+    return vol

@@ -1,0 +1,86 @@
+"""Interval math: exhaustive consistency between locate_data and a
+brute-force byte-position model of the striping layout."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import ec_locate
+from seaweedfs_tpu.storage.ec_locate import (Interval, large_rows_count,
+                                             locate_data, shard_file_size)
+
+
+def brute_position(offset, dat_size, k, large, small):
+    """Map ONE logical byte offset to (shard, shard_file_offset) by
+    walking the layout definition directly."""
+    rows = large_rows_count(dat_size, k, large)
+    large_region = rows * large * k
+    if offset < large_region:
+        row, row_off = divmod(offset, large * k)
+        shard, inner = divmod(row_off, large)
+        return shard, row * large + inner
+    region_off = offset - large_region
+    row, row_off = divmod(region_off, small * k)
+    shard, inner = divmod(row_off, small)
+    return shard, rows * large + row * small + inner
+
+
+@pytest.mark.parametrize("k,large,small", [(10, 1024, 64), (6, 512, 32),
+                                           (3, 256, 16)])
+def test_locate_matches_brute_force(k, large, small):
+    rng = np.random.default_rng(k)
+    # Cover: pure-small volume, exactly-one-large-row volume, mixed.
+    for dat_size in (small * k - 5, large * k, large * k + 1,
+                     3 * large * k + 2 * small * k + 17):
+        for _ in range(200):
+            offset = int(rng.integers(0, dat_size))
+            size = int(rng.integers(1, min(dat_size - offset, 4 * small)
+                                    + 1))
+            intervals = locate_data(offset, size, dat_size, k, large, small)
+            # Total size preserved, pieces contiguous in logical space.
+            assert sum(iv.size for iv in intervals) == size
+            pos = offset
+            for iv in intervals:
+                shard, file_off = brute_position(pos, dat_size, k, large,
+                                                 small)
+                assert iv.shard_id == shard
+                assert iv.inner_block_offset == file_off
+                # Every byte of the interval stays in one block of one
+                # shard: check the last byte too.
+                shard_end, file_end = brute_position(pos + iv.size - 1,
+                                                     dat_size, k, large,
+                                                     small)
+                assert shard_end == shard
+                assert file_end == file_off + iv.size - 1
+                pos += iv.size
+
+
+def test_large_rows_boundary_semantics():
+    k, large = 10, 1024
+    # Strictly-greater loop: an exactly one-large-row file has 0 large rows.
+    assert large_rows_count(large * k, k, large) == 0
+    assert large_rows_count(large * k + 1, k, large) == 1
+    assert large_rows_count(3 * large * k, k, large) == 2
+
+
+def test_shard_file_size_covers_dat():
+    k, large, small = 10, 1024, 64
+    for dat_size in (1, small * k, large * k + small + 3,
+                     2 * large * k + 5):
+        sz = shard_file_size(dat_size, k, large, small)
+        # k shard files hold at least the whole dat (with padding).
+        assert sz * k >= dat_size
+        # Padding never exceeds one small row.
+        assert sz * k < dat_size + small * k
+
+
+def test_locate_rejects_out_of_range():
+    with pytest.raises(ValueError):
+        locate_data(10, 100, 50, 10, 1024, 64)
+    with pytest.raises(ValueError):
+        locate_data(-1, 5, 50, 10, 1024, 64)
+
+
+def test_single_interval_within_block():
+    ivs = locate_data(0, 10, 1000, 10, 1024, 64)
+    assert ivs == [Interval(shard_id=0, inner_block_offset=0, size=10,
+                            is_large_block=False, block_index=0)]

@@ -1,0 +1,233 @@
+"""On-disk format tests: needle codec, superblock, idx/ecx, crc, fid."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import crc, ec_files, idx, needle, superblock
+from seaweedfs_tpu.storage.types import (FileId, NEEDLE_MAP_ENTRY_SIZE,
+                                         TOMBSTONE_FILE_SIZE)
+
+
+# -- crc32c -----------------------------------------------------------------
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / common test vectors for CRC32-C.
+    assert crc.crc32c(b"") == 0
+    assert crc.crc32c(b"123456789") == 0xE3069283
+    assert crc.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc.crc32c(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_crc32c_fast_matches_slow():
+    rng = np.random.default_rng(0)
+    for size in (0, 1, 7, 8, 9, 63, 64, 1000):
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        assert crc.crc32c(data) == crc.crc32c_slow(data)
+
+
+# -- file ids ---------------------------------------------------------------
+
+def test_fileid_roundtrip():
+    fid = FileId(volume_id=3, key=0x1637, cookie=0x037D6AFE)
+    s = str(fid)
+    assert s == "3,1637037d6afe"
+    back = FileId.parse(s)
+    assert back == fid
+
+
+def test_fileid_malformed():
+    for bad in ("nocomma", "3,", "3,12345678", "x,123456789"):
+        with pytest.raises(ValueError):
+            FileId.parse(bad)
+
+
+# -- needle codec -----------------------------------------------------------
+
+def test_needle_roundtrip_v3_plain():
+    n = needle.Needle(cookie=0xDEADBEEF, id=42, data=b"hello world",
+                      append_at_ns=123456789)
+    raw = n.to_bytes(3)
+    assert len(raw) % 8 == 0
+    back = needle.Needle.parse(raw, 3)
+    assert back.cookie == n.cookie and back.id == n.id
+    assert back.data == n.data
+    assert back.append_at_ns == 123456789
+
+
+def test_needle_roundtrip_all_optional_fields():
+    n = needle.Needle(cookie=1, id=2, data=b"x" * 100, name=b"file.txt",
+                      mime=b"text/plain", last_modified=1_700_000_000,
+                      ttl=b"\x03\x03", pairs=b'{"k":"v"}',
+                      append_at_ns=5)
+    back = needle.Needle.parse(n.to_bytes(3), 3)
+    assert back.name == b"file.txt"
+    assert back.mime == b"text/plain"
+    assert back.last_modified == 1_700_000_000
+    assert back.ttl == b"\x03\x03"
+    assert back.pairs == b'{"k":"v"}'
+    assert back.data == b"x" * 100
+
+
+def test_needle_crc_verified_on_parse():
+    n = needle.Needle(cookie=1, id=2, data=b"payload", append_at_ns=1)
+    raw = bytearray(n.to_bytes(3))
+    # Flip a data byte: offset 16 (header) + 4 (datasize) = first data byte.
+    raw[20] ^= 0xFF
+    with pytest.raises(needle.NeedleError, match="crc"):
+        needle.Needle.parse(bytes(raw), 3)
+    needle.Needle.parse(bytes(raw), 3, verify_checksum=False)  # no raise
+
+
+def test_needle_header_layout_bigendian():
+    n = needle.Needle(cookie=0x01020304, id=0x05060708090A0B0C,
+                      data=b"d", append_at_ns=1)
+    raw = n.to_bytes(3)
+    assert raw[:4] == bytes([1, 2, 3, 4])
+    assert raw[4:12] == bytes([5, 6, 7, 8, 9, 10, 11, 12])
+    # Size field counts body: 4 (datasize) + 1 (data) + 1 (flags) = 6.
+    assert struct.unpack(">I", raw[12:16])[0] == 6
+
+
+def test_needle_v1_roundtrip():
+    n = needle.Needle(cookie=9, id=8, data=b"legacy")
+    raw = n.to_bytes(1)
+    back = needle.Needle.parse(raw, 1, verify_checksum=False)
+    assert back.data == b"legacy"
+
+
+def test_record_size_matches_to_bytes():
+    for data_len in (0, 1, 7, 8, 100):
+        n = needle.Needle(cookie=1, id=2, data=b"z" * data_len,
+                          append_at_ns=1)
+        raw = n.to_bytes(3)
+        body = struct.unpack(">I", raw[12:16])[0]
+        assert needle.record_size(body, 3) == len(raw)
+
+
+# -- superblock -------------------------------------------------------------
+
+def test_superblock_roundtrip():
+    sb = superblock.SuperBlock(
+        version=3,
+        replica_placement=superblock.ReplicaPlacement.parse("110"),
+        ttl=superblock.Ttl.parse("3d"), compact_revision=7)
+    raw = sb.to_bytes()
+    assert len(raw) == 8
+    back = superblock.SuperBlock.parse(raw)
+    assert back.version == 3
+    assert str(back.replica_placement) == "110"
+    assert str(back.ttl) == "3d"
+    assert back.compact_revision == 7
+
+
+def test_superblock_byte_layout():
+    sb = superblock.SuperBlock(
+        version=3,
+        replica_placement=superblock.ReplicaPlacement.parse("001"),
+        compact_revision=0x0102)
+    raw = sb.to_bytes()
+    assert raw[0] == 3
+    assert raw[1] == 1  # 001 -> byte 1
+    assert raw[4:6] == b"\x01\x02"
+
+
+def test_replica_placement_codes():
+    for code, copies in [("000", 1), ("001", 2), ("010", 2), ("100", 2),
+                         ("110", 3), ("200", 3)]:
+        rp = superblock.ReplicaPlacement.parse(code)
+        assert str(rp) == code
+        assert rp.copy_count() == copies
+        assert superblock.ReplicaPlacement.from_byte(rp.to_byte()) == rp
+
+
+# -- idx / ecx --------------------------------------------------------------
+
+def test_index_entry_layout():
+    e = idx.IndexEntry(key=0x0102030405060708, offset_units=0x0A0B0C0D,
+                       size=0x11121314)
+    raw = e.to_bytes()
+    assert raw == bytes([1, 2, 3, 4, 5, 6, 7, 8,
+                         0x0A, 0x0B, 0x0C, 0x0D, 0x11, 0x12, 0x13, 0x14])
+    assert idx.IndexEntry.from_bytes(raw) == e
+
+
+def test_compact_map_supersede_and_delete():
+    m = idx.CompactMap()
+    m.set(1, 10, 100)
+    m.set(1, 20, 200)  # supersedes
+    assert m.get(1).offset_units == 20
+    assert m.deleted_count == 1 and m.deleted_bytes == 100
+    assert m.delete(1)
+    assert m.get(1) is None
+    assert not m.delete(1)  # already gone
+
+
+def test_write_sorted_ecx(tmp_path):
+    ip = tmp_path / "v.idx"
+    entries = [idx.IndexEntry(5, 1, 10), idx.IndexEntry(2, 2, 20),
+               idx.IndexEntry(9, 3, 30), idx.IndexEntry(2, 4, 25),
+               idx.IndexEntry(9, 0, TOMBSTONE_FILE_SIZE)]
+    ip.write_bytes(b"".join(e.to_bytes() for e in entries))
+    ep = tmp_path / "v.ecx"
+    n = idx.write_sorted_ecx_from_idx(ip, ep)
+    assert n == 2
+    got = list(idx.walk_index_blob(ep.read_bytes()))
+    assert [e.key for e in got] == [2, 5]
+    assert got[0].offset_units == 4  # superseded entry wins
+    # binary search, blob and file variants
+    assert idx.search_ecx_blob(ep.read_bytes(), 5).offset_units == 1
+    assert idx.search_ecx_file(ep, 2).size == 25
+    assert idx.search_ecx_file(ep, 7) is None
+
+
+# -- ec file helpers --------------------------------------------------------
+
+def test_shard_ext_names():
+    assert ec_files.shard_ext(0) == ".ec00"
+    assert ec_files.shard_ext(13) == ".ec13"
+    with pytest.raises(ValueError):
+        ec_files.shard_ext(-1)
+
+
+def test_ecj_journal(tmp_path):
+    base = tmp_path / "3"
+    assert ec_files.ecj_read(base) == []
+    ec_files.ecj_append(base, 42)
+    ec_files.ecj_append(base, 7)
+    assert ec_files.ecj_read(base) == [42, 7]
+    assert ec_files.ecj_deleted_set(base) == {7, 42}
+
+
+def test_vif_roundtrip(tmp_path):
+    base = tmp_path / "3"
+    vi = ec_files.VolumeInfo(version=3, replication="010",
+                             dat_file_size=12345)
+    vi.save(base)
+    back = ec_files.VolumeInfo.load(base)
+    assert back.version == 3
+    assert back.replication == "010"
+    assert back.dat_file_size == 12345
+
+
+def test_shard_bits():
+    b = ec_files.ShardBits.from_ids([0, 3, 13])
+    assert b.has(3) and not b.has(1)
+    assert b.ids() == [0, 3, 13]
+    assert b.count() == 3
+    assert b.add(1).ids() == [0, 1, 3, 13]
+    assert b.remove(3).ids() == [0, 13]
+
+
+def test_needle_truncated_optional_fields_raise():
+    """Corrupt bodies must error, not parse silently with zero fields."""
+    n = needle.Needle(cookie=1, id=2, data=b"abc",
+                      last_modified=1_700_000_000, append_at_ns=1)
+    raw = bytearray(n.to_bytes(3))
+    # Shrink the header Size so the last_modified field falls outside the
+    # body while the flag still claims it exists.
+    body_size = struct.unpack(">I", raw[12:16])[0]
+    raw[12:16] = struct.pack(">I", body_size - 3)
+    with pytest.raises(needle.NeedleError, match="truncated|crc"):
+        needle.Needle.parse(bytes(raw), 3, verify_checksum=False)

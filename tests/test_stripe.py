@@ -1,0 +1,77 @@
+"""Striping transforms: stripe/unstripe inverses, row batching."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.pipeline.scheme import EcScheme
+from seaweedfs_tpu.pipeline.stripe import (iter_row_batches, stripe,
+                                           stripe_rows, unstripe)
+
+SCHEME = EcScheme(data_shards=4, parity_shards=2, large_block_size=512,
+                  small_block_size=64)
+
+
+@pytest.mark.parametrize("size", [
+    1, 63, 64, 64 * 4, 512 * 4,            # pure small / boundary
+    512 * 4 + 1, 512 * 4 * 3 + 100,        # mixed large+small
+])
+def test_stripe_unstripe_roundtrip(size):
+    rng = np.random.default_rng(size)
+    dat = rng.integers(0, 256, size, dtype=np.uint8)
+    shards = stripe(dat, SCHEME)
+    assert len(shards) == 4
+    assert all(s.size == SCHEME.shard_file_size(size) for s in shards)
+    back = unstripe(shards, size, SCHEME)
+    assert np.array_equal(back, dat)
+
+
+def test_stripe_rows_covers_dat_in_order():
+    rng = np.random.default_rng(0)
+    size = 512 * 4 * 2 + 64 * 4 + 7
+    dat = rng.integers(0, 256, size, dtype=np.uint8)
+    collected = []
+    kinds = []
+    for rows, is_large in stripe_rows(dat, SCHEME):
+        kinds.append(is_large)
+        collected.append(rows.reshape(-1))
+    assert kinds == [True, False]
+    flat = np.concatenate(collected)
+    assert np.array_equal(flat[:size], dat)
+    assert (flat[size:] == 0).all()  # zero padding
+
+
+def test_unstripe_validates_sizes():
+    with pytest.raises(ValueError):
+        unstripe([np.zeros(10, dtype=np.uint8)] * 3, 30, SCHEME)
+    bad = [np.zeros(10, dtype=np.uint8)] * 3 + [np.zeros(9, dtype=np.uint8)]
+    with pytest.raises(ValueError):
+        unstripe(bad, 30, SCHEME)
+    with pytest.raises(ValueError):
+        # Right count, wrong per-shard size for the dat size.
+        unstripe([np.zeros(10, dtype=np.uint8)] * 4, 10_000, SCHEME)
+
+
+def test_iter_row_batches_bounds():
+    rows = np.zeros((10, 4, 64), dtype=np.uint8)
+    batches = list(iter_row_batches(rows, max_batch_bytes=4 * 64 * 3))
+    assert [b.shape[0] for b in batches] == [3, 3, 3, 1]
+    # Degenerate bound still yields whole rows.
+    batches = list(iter_row_batches(rows, max_batch_bytes=1))
+    assert [b.shape[0] for b in batches] == [1] * 10
+
+
+def test_iter_row_batches_column_split_for_oversized_rows():
+    """One row larger than the bound splits along the block axis; the
+    append-order concatenation must equal the original row."""
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 256, (2, 4, 1024), dtype=np.uint8)
+    batches = list(iter_row_batches(rows, max_batch_bytes=4 * 256))
+    assert all(b.shape[0] == 1 for b in batches)
+    assert all(b.shape[2] <= 256 for b in batches)
+    assert all(b.shape[2] % 128 == 0 or b is batches[-1] for b in batches)
+    # Reassemble shard-file append order: concat over batches per shard.
+    per_shard = [np.concatenate([b[0, s] for b in batches])
+                 for s in range(4)]
+    for s in range(4):
+        assert np.array_equal(per_shard[s],
+                              rows[:, s, :].reshape(-1))
