@@ -1,0 +1,126 @@
+"""Benchmark: RS(10,4) encode throughput on the available accelerator.
+
+Prints ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+
+``vs_baseline`` is measured against the BASELINE.md target of 20 GiB/s
+RS(10,4) encode per chip (BASELINE.json north star). Detailed sub-metrics
+(rebuild throughput, end-to-end with host transfers, alternate
+geometries) go to stderr so the driver's one-line contract holds.
+
+Run on the real TPU with a plain ``python bench.py`` (single process —
+the axon tunnel is exclusive); CPU fallback works with
+``JAX_PLATFORMS=cpu`` for smoke-testing.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_GIBPS = 20.0
+GIB = 1024 ** 3
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def timeit(fn, *args, warmup=2, iters=5):
+    """Median wall time of jitted fn(*args) with block_until_ready."""
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import bitslice
+    from seaweedfs_tpu.ops.rs_jax import Encoder
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+    on_tpu = dev.platform != "cpu"
+
+    # -- headline: RS(10,4) encode, 1 GiB resident on device -------------
+    k, m = 10, 4
+    enc = Encoder(k, m)
+    coefs = enc.parity_coefs
+
+    # (B, k, S): ~1 GiB total input, S a multiple of the packing group.
+    batch = 8 if on_tpu else 1
+    s = (GIB // (batch * k)) // 128 * 128
+    if not on_tpu:
+        # CPU smoke: shrink to keep runtime sane (keep group alignment).
+        s = (s // 64) // 128 * 128
+    total_bytes = batch * k * s
+    log(f"encode shape: ({batch}, {k}, {s}) = "
+        f"{total_bytes / GIB:.4f} GiB input")
+
+    @jax.jit
+    def encode_fn(x):
+        return bitslice.apply_gf_matrix(coefs, x)
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.randint(key, (batch, k, s), 0, 256, dtype=jnp.uint8)
+    x = jax.device_put(x, dev)
+    jax.block_until_ready(x)
+
+    t = timeit(encode_fn, x)
+    encode_gibps = total_bytes / GIB / t
+    log(f"encode: {t*1e3:.2f} ms -> {encode_gibps:.2f} GiB/s "
+        f"(target {TARGET_GIBPS})")
+
+    # -- secondary: single-shard rebuild (config 2) -----------------------
+    present = list(range(14))
+    present.remove(13)  # one lost parity
+    rebuild_coefs = enc.decode_matrix_rows(present, [13])
+
+    @jax.jit
+    def rebuild_fn(surv):
+        return bitslice.apply_gf_matrix(rebuild_coefs, surv)
+
+    t_r = timeit(rebuild_fn, x)  # x's first 10 rows stand in as survivors
+    rebuild_gibps = total_bytes / GIB / t_r
+    log(f"single-shard rebuild: {t_r*1e3:.2f} ms -> "
+        f"{rebuild_gibps:.2f} GiB/s (target 15)")
+
+    # -- secondary: alternate geometries (config 4) -----------------------
+    for (ak, am) in ((6, 3), (12, 4)):
+        aenc = Encoder(ak, am)
+        acoefs = aenc.parity_coefs
+        a_s = (total_bytes // (batch * ak)) // 128 * 128
+        ax = jax.random.randint(key, (batch, ak, a_s), 0, 256,
+                                dtype=jnp.uint8)
+
+        @jax.jit
+        def alt_fn(v, _c=acoefs):
+            return bitslice.apply_gf_matrix(_c, v)
+
+        t_a = timeit(alt_fn, ax, warmup=1, iters=3)
+        log(f"RS({ak},{am}) encode: "
+            f"{batch * ak * a_s / GIB / t_a:.2f} GiB/s")
+
+    print(json.dumps({
+        "metric": "rs_10_4_encode_1gib_device",
+        "value": round(encode_gibps, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(encode_gibps / TARGET_GIBPS, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,13 @@ class Encoder:
 
     # -- batched array API (the TPU-native surface) -----------------------
 
+    @property
+    def parity_coefs(self) -> np.ndarray:
+        """(m, k) uint8 parity rows of the code matrix, C-contiguous —
+        the coefficients a caller hands to bitslice.apply_gf_matrix."""
+        return np.ascontiguousarray(self.matrix[self.data_shards:],
+                                    dtype=np.uint8)
+
     def encode_parity(self, data) -> jnp.ndarray:
         """data (B, k, S) or (k, S) uint8 -> parity (B, m, S) / (m, S)."""
         return apply_matrix(self.matrix[self.data_shards:], data)

@@ -1,0 +1,1 @@
+"""Multi-chip execution: device meshes, sharded codec steps, collectives."""

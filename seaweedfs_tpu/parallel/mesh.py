@@ -1,0 +1,142 @@
+"""Sharded EC steps over a jax.sharding.Mesh.
+
+The reference distributes EC work by placing the 14 shard files on
+different servers and moving bytes with gRPC (SURVEY.md §2 "parallelism
+strategies" table). The TPU-native equivalent keeps the math on a device
+mesh instead:
+
+* ``dp`` (volume/batch axis): independent volumes spread across chips —
+  the analog of many volume servers encoding concurrently.
+* ``sp`` (stripe axis): one volume's byte range split across chips — the
+  analog of the reference striping one .dat over shard servers. The
+  bitsliced codec is positionwise over 128-byte groups, so stripe-axis
+  sharding needs NO communication for encode; only the global integrity
+  checksum crosses chips (one psum over the mesh, riding ICI).
+
+Steps are built with shard_map so the collective structure is explicit
+and compiles to XLA collectives; the same code runs on a virtual CPU mesh
+(tests, the driver's dry-run) and a real TPU pod slice.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import bitslice
+from ..ops.rs_jax import Encoder
+
+GROUP = bitslice.GROUP_BYTES
+
+
+def make_mesh(devices=None, dp: Optional[int] = None,
+              sp: Optional[int] = None) -> Mesh:
+    """Build a (dp, sp) mesh over the given devices (default: all).
+
+    Without explicit sizes, picks the most-square factorization with the
+    stripe axis at least as large as the batch axis (stripe parallelism
+    is communication-free here, so over-sharding it is harmless).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if dp is None and sp is None:
+        dp = 1
+        for f in range(int(math.isqrt(n)), 0, -1):
+            if n % f == 0:
+                dp = f
+                break
+        sp = n // dp
+    elif dp is None:
+        if n % sp:
+            raise ValueError(f"sp={sp} does not divide device count {n}")
+        dp = n // sp
+    elif sp is None:
+        if n % dp:
+            raise ValueError(f"dp={dp} does not divide device count {n}")
+        sp = n // dp
+    if dp * sp != n:
+        raise ValueError(f"dp*sp = {dp}*{sp} != device count {n}")
+    dev_array = np.array(devices).reshape(dp, sp)
+    return Mesh(dev_array, axis_names=("dp", "sp"))
+
+
+def make_sharded_encode_step(encoder: Encoder, mesh: Mesh):
+    """jitted (B, k, S) u8 -> ((B, m, S) parity, scalar checksum).
+
+    Input sharded (dp, -, sp); parity keeps the same sharding; the
+    checksum is the byte-sum of the parity **mod 2^32** (uint32
+    accumulation), psum-reduced over BOTH axes so every chip holds the
+    global value (the cross-chip integrity handshake a multi-server
+    encode does over gRPC in the reference). Host-side verifiers must
+    reduce mod 2^32 too.
+    """
+    coefs = encoder.parity_coefs
+
+    def step(x):
+        parity = bitslice.apply_gf_matrix(coefs, x)
+        local = jnp.sum(parity.astype(jnp.uint32), dtype=jnp.uint32)
+        total = jax.lax.psum(local, ("dp", "sp"))
+        return parity, total
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=P("dp", None, "sp"),
+        out_specs=(P("dp", None, "sp"), P()),
+    )
+    return jax.jit(mapped)
+
+
+def make_sharded_train_step(encoder: Encoder, mesh: Mesh,
+                            lost: tuple[int, ...] = (0,)):
+    """The FULL EC 'training step' used by the driver's multi-chip dry run:
+    encode -> drop ``lost`` shards -> reconstruct them -> verify they match
+    the originals, returning ((B, m, S) parity, scalar mismatch count).
+
+    Exercises the complete device-side math (both matrix applications) plus
+    a global psum, all under one jit over the mesh.
+    """
+    k, m = encoder.data_shards, encoder.parity_shards
+    total_n = encoder.total_shards
+    parity_coefs = encoder.parity_coefs
+    lost = tuple(sorted(lost))
+    present = [i for i in range(total_n) if i not in lost]
+    rebuild_coefs = encoder.decode_matrix_rows(present, list(lost))
+
+    def step(x):
+        parity = bitslice.apply_gf_matrix(parity_coefs, x)
+        full = jnp.concatenate([x, parity], axis=1)
+        originals = full[:, lost, :]
+        survivors = full[:, present[:k], :]
+        rebuilt = bitslice.apply_gf_matrix(rebuild_coefs, survivors)
+        local_bad = jnp.sum((rebuilt != originals).astype(jnp.uint32),
+                            dtype=jnp.uint32)
+        mismatches = jax.lax.psum(local_bad, ("dp", "sp"))
+        return parity, mismatches
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=P("dp", None, "sp"),
+        out_specs=(P("dp", None, "sp"), P()),
+    )
+    return jax.jit(mapped)
+
+
+def shard_batch(x: np.ndarray, mesh: Mesh):
+    """Device-put a (B, k, S) batch with (dp, -, sp) sharding; validates
+    divisibility (S per chip must stay a multiple of the packing group)."""
+    dp = mesh.shape["dp"]
+    sp = mesh.shape["sp"]
+    b, _, s = x.shape
+    if b % dp:
+        raise ValueError(f"batch {b} not divisible by dp={dp}")
+    if s % (sp * GROUP):
+        raise ValueError(
+            f"shard length {s} not divisible by sp*{GROUP} = {sp * GROUP}")
+    sharding = NamedSharding(mesh, P("dp", None, "sp"))
+    return jax.device_put(x, sharding)
