@@ -1,0 +1,42 @@
+"""Top-level CLI dispatcher — the `weed` binary analog.
+
+Mirrors weed/weed.go + weed/command/command.go (SURVEY.md §2 "CLI
+dispatcher"): a table of subcommands, each owning its flags:
+
+    python -m seaweedfs_tpu shell  -dir ...      admin shell (REPL / -c)
+    python -m seaweedfs_tpu ...                  (servers land with the
+                                                  gRPC layer)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _run_shell(argv: list[str]) -> int:
+    from .shell.cli import main
+    return main(argv)
+
+
+COMMANDS = {
+    "shell": _run_shell,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print("usage: python -m seaweedfs_tpu <command> [flags]\n\n"
+              "commands:\n  " + "\n  ".join(sorted(COMMANDS)),
+              file=sys.stderr)
+        return 0 if argv else 1
+    name = argv[0]
+    fn = COMMANDS.get(name)
+    if fn is None:
+        print(f"unknown command {name!r}", file=sys.stderr)
+        return 1
+    return fn(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

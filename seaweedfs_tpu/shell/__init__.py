@@ -1,0 +1,3 @@
+"""Admin shell: the `weed shell` analog (SURVEY.md §2 "Shell" row)."""
+
+from .commands import COMMANDS, CommandEnv, run_command  # noqa: F401

@@ -1,0 +1,59 @@
+"""Shell entry point: REPL or one-shot command execution.
+
+`weed shell` analog (weed/command/shell.go): interactive loop reading
+commands against the configured disk locations; ``-c`` runs one command
+and exits (useful for scripts and tests):
+
+    python -m seaweedfs_tpu shell -dir /data/vol1 -dir /data/vol2
+    python -m seaweedfs_tpu shell -dir /data -c "ec.encode -volumeId 3"
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..storage.store import Store
+from .commands import CommandEnv, ShellError, run_command
+
+
+def build_env(dirs: list[str], max_volumes: int = 8) -> CommandEnv:
+    store = Store(dirs, max_volumes=max_volumes)
+    store.load_existing()
+    return CommandEnv(store=store)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="shell", allow_abbrev=False)
+    p.add_argument("-dir", action="append", required=True,
+                   help="disk location (repeatable)")
+    p.add_argument("-maxVolumes", type=int, default=8)
+    p.add_argument("-c", dest="oneshot", default=None,
+                   help="run one command and exit")
+    args = p.parse_args(argv)
+    env = build_env(args.dir, args.maxVolumes)
+    try:
+        if args.oneshot is not None:
+            try:
+                run_command(env, args.oneshot)
+            except ShellError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            return 0
+        while True:
+            try:
+                line = input("> ")
+            except EOFError:
+                return 0
+            if line.strip() in ("exit", "quit"):
+                return 0
+            try:
+                run_command(env, line)
+            except ShellError as e:
+                print(f"error: {e}", file=sys.stderr)
+    finally:
+        env.store.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,315 @@
+"""Store: disk locations, the volume registry, and EC shard mounts.
+
+Mirrors weed/storage/store.go + disk_location.go + store_ec.go (SURVEY.md
+§2 "Store / Volume engine" and "EC read path" rows): a Store owns one or
+more directories ("disk locations"), each holding normal volumes
+(<base>.dat/.idx) and mounted EC shards (<base>.ec??/.ecx). The volume
+server (L3) dispatches every data-plane and admin operation through this
+object; heartbeats to the master are built from its `status()` snapshot.
+
+Volume base naming follows the reference: ``<vid>`` or
+``<collection>_<vid>`` inside the location directory.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Optional
+
+from . import ec_files
+from .needle import Needle
+from .superblock import ReplicaPlacement, SuperBlock, Ttl
+from .volume import Volume, VolumeError, dat_path, idx_path
+
+_BASE_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)$")
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+def volume_base_name(volume_id: int, collection: str = "") -> str:
+    return f"{collection}_{volume_id}" if collection else str(volume_id)
+
+
+def parse_base_name(stem: str) -> tuple[str, int]:
+    """'<collection>_<vid>' / '<vid>' -> (collection, vid)."""
+    m = _BASE_RE.match(stem)
+    if not m:
+        raise ValueError(f"not a volume base name: {stem!r}")
+    return m.group("col") or "", int(m.group("vid"))
+
+
+@dataclass
+class EcVolumeMount:
+    """Local mount state of one EC volume: which shard files this store
+    serves (ec_volume.go EcVolume, minus the remote-peer logic that lives
+    in the server layer)."""
+
+    base: Path
+    collection: str
+    volume_id: int
+    shard_ids: set[int] = field(default_factory=set)
+
+    @property
+    def shard_bits(self) -> ec_files.ShardBits:
+        return ec_files.ShardBits.from_ids(sorted(self.shard_ids))
+
+
+class DiskLocation:
+    """One directory of volume/shard files (disk_location.go)."""
+
+    def __init__(self, directory: str | Path, max_volumes: int = 8):
+        self.directory = Path(directory)
+        self.max_volumes = max_volumes
+        if not self.directory.is_dir():
+            raise StoreError(f"{self.directory} is not a directory")
+
+    def base_for(self, volume_id: int, collection: str = "") -> Path:
+        return self.directory / volume_base_name(volume_id, collection)
+
+    def scan_volumes(self) -> Iterator[tuple[str, int, Path]]:
+        """Yield (collection, vid, base) for every <base>.dat present."""
+        for p in sorted(self.directory.glob("*.dat")):
+            try:
+                col, vid = parse_base_name(p.stem)
+            except ValueError:
+                continue
+            yield col, vid, p.with_suffix("")
+
+    def scan_ec_shards(self) -> Iterator[tuple[str, int, Path, list[int]]]:
+        """Yield (collection, vid, base, shard_ids) for bases that have at
+        least one .ec?? file AND a .ecx index."""
+        seen: dict[Path, list[int]] = {}
+        for p in sorted(self.directory.iterdir()):
+            m = re.match(r"^\.ec(\d\d)$", p.suffix)
+            if not m:
+                continue
+            seen.setdefault(p.with_suffix(""), []).append(int(m.group(1)))
+        for base, ids in seen.items():
+            if not ec_files.ecx_path(base).exists():
+                continue
+            try:
+                col, vid = parse_base_name(base.name)
+            except ValueError:
+                continue
+            yield col, vid, base, sorted(ids)
+
+
+class Store:
+    """The storage engine facade the volume server drives (store.go)."""
+
+    def __init__(self, locations: list[str | Path],
+                 max_volumes: int = 8):
+        if not locations:
+            raise StoreError("a store needs at least one disk location")
+        self.locations = [DiskLocation(d, max_volumes) for d in locations]
+        self.volumes: dict[tuple[str, int], Volume] = {}
+        self.ec_mounts: dict[tuple[str, int], EcVolumeMount] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def load_existing(self) -> None:
+        """Scan every location and open what's on disk (volume_loading.go;
+        EC shards found with their .ecx are auto-mounted the way the
+        reference remounts shards on restart)."""
+        for loc in self.locations:
+            for col, vid, base in loc.scan_volumes():
+                if (col, vid) not in self.volumes:
+                    self.volumes[(col, vid)] = Volume(base, vid).load()
+            for col, vid, base, ids in loc.scan_ec_shards():
+                m = self.ec_mounts.setdefault(
+                    (col, vid), EcVolumeMount(base, col, vid))
+                m.shard_ids.update(ids)
+
+    def close(self) -> None:
+        for v in self.volumes.values():
+            v.close()
+        self.volumes.clear()
+        self.ec_mounts.clear()
+
+    def _pick_location(self) -> DiskLocation:
+        """Least-loaded location with free volume slots."""
+        def load(loc: DiskLocation) -> int:
+            return sum(1 for v in self.volumes.values()
+                       if v.base.parent == loc.directory)
+        candidates = [l for l in self.locations
+                      if load(l) < l.max_volumes]
+        if not candidates:
+            raise StoreError("no disk location has free volume slots")
+        return min(candidates, key=load)
+
+    # -- normal volumes ---------------------------------------------------
+
+    def create_volume(self, volume_id: int, collection: str = "",
+                      replica_placement: str = "000", ttl: str = "",
+                      version: int = 3) -> Volume:
+        key = (collection, volume_id)
+        if key in self.volumes:
+            raise StoreError(f"volume {volume_id} already exists")
+        loc = self._pick_location()
+        sb = SuperBlock(
+            version=version,
+            replica_placement=ReplicaPlacement.parse(replica_placement),
+            ttl=Ttl.parse(ttl))
+        vol = Volume(loc.base_for(volume_id, collection), volume_id,
+                     sb).create()
+        self.volumes[key] = vol
+        return vol
+
+    def get_volume(self, volume_id: int, collection: str = "") -> Volume:
+        try:
+            return self.volumes[(collection, volume_id)]
+        except KeyError:
+            raise StoreError(f"volume {volume_id} not found") from None
+
+    def has_volume(self, volume_id: int, collection: str = "") -> bool:
+        return (collection, volume_id) in self.volumes
+
+    def delete_volume(self, volume_id: int, collection: str = "") -> None:
+        """Drop the .dat/.idx (ec.encode's final step deletes the source
+        volume this way)."""
+        vol = self.get_volume(volume_id, collection)
+        vol.close()
+        del self.volumes[(collection, volume_id)]
+        for p in (dat_path(vol.base), idx_path(vol.base)):
+            if p.exists():
+                p.unlink()
+
+    # -- data plane -------------------------------------------------------
+
+    def write_needle(self, volume_id: int, n: Needle,
+                     collection: str = "") -> int:
+        return self.get_volume(volume_id, collection).write_needle(n)
+
+    def read_needle(self, volume_id: int, key: int,
+                    cookie: Optional[int] = None,
+                    collection: str = "") -> Needle:
+        return self.get_volume(volume_id, collection).read_needle(
+            key, cookie)
+
+    def delete_needle(self, volume_id: int, key: int,
+                      collection: str = "") -> bool:
+        return self.get_volume(volume_id, collection).delete_needle(key)
+
+    # -- EC shards --------------------------------------------------------
+
+    def ec_base(self, volume_id: int, collection: str = ""
+                ) -> Optional[Path]:
+        m = self.ec_mounts.get((collection, volume_id))
+        if m is not None:
+            return m.base
+        for loc in self.locations:
+            base = loc.base_for(volume_id, collection)
+            if ec_files.ecx_path(base).exists():
+                return base
+        return None
+
+    def ec_shard_paths(self, volume_id: int, collection: str = ""
+                       ) -> dict[int, Path]:
+        """shard_id -> file path, looking across ALL disk locations (the
+        local-mode analog of asking the master where shards live)."""
+        name = volume_base_name(volume_id, collection)
+        out: dict[int, Path] = {}
+        for loc in self.locations:
+            base = loc.directory / name
+            for i in ec_files.present_shards(base, 100):
+                out.setdefault(i, ec_files.shard_path(base, i))
+        return out
+
+    def gather_ec_volume(self, volume_id: int, collection: str = ""
+                         ) -> Path:
+        """Make every shard of an EC volume reachable under ONE base path
+        by symlinking siblings from other locations — the local-mode form
+        of ec.rebuild's 'copy missing sibling shards local' step
+        (§3.5) before Reconstruct runs. Returns that base."""
+        base = self.ec_base(volume_id, collection)
+        if base is None:
+            raise StoreError(f"no EC volume {volume_id}")
+        for sid, path in self.ec_shard_paths(volume_id, collection).items():
+            local = ec_files.shard_path(base, sid)
+            if not local.exists():
+                if local.is_symlink():  # stale/broken link
+                    local.unlink()
+                # absolute target: a relative one would resolve against
+                # the location directory and dangle
+                local.symlink_to(path.resolve())
+        # the delete journal and volume info may live beside a moved shard
+        name = volume_base_name(volume_id, collection)
+        for pathfn in (ec_files.ecj_path, ec_files.vif_path):
+            local = pathfn(base)
+            if local.exists():
+                continue
+            if local.is_symlink():
+                local.unlink()
+            for loc in self.locations:
+                other = pathfn(loc.directory / name)
+                if other.exists() and other.resolve() != local.resolve():
+                    local.symlink_to(other.resolve())
+                    break
+        return base
+
+    def remove_ec_volume_files(self, volume_id: int, collection: str = ""
+                               ) -> None:
+        """Delete every EC artifact of a volume in every location
+        (symlinks and real files both)."""
+        name = volume_base_name(volume_id, collection)
+        for loc in self.locations:
+            base = loc.directory / name
+            for i in range(100):
+                p = ec_files.shard_path(base, i)
+                if p.exists() or p.is_symlink():
+                    p.unlink()
+            for p in (ec_files.ecx_path(base), ec_files.ecj_path(base),
+                      ec_files.vif_path(base)):
+                if p.exists() or p.is_symlink():
+                    p.unlink()
+
+    def mount_ec_shards(self, volume_id: int, shard_ids: list[int],
+                        collection: str = "") -> EcVolumeMount:
+        """VolumeEcShardsMount: register local shard files for serving."""
+        base = self.ec_base(volume_id, collection)
+        if base is None:
+            raise StoreError(
+                f"no .ecx for volume {volume_id} in any location")
+        missing = [i for i in shard_ids
+                   if not ec_files.shard_path(base, i).exists()]
+        if missing:
+            raise StoreError(
+                f"shard files missing for volume {volume_id}: {missing}")
+        m = self.ec_mounts.setdefault(
+            (collection, volume_id),
+            EcVolumeMount(base, collection, volume_id))
+        m.shard_ids.update(shard_ids)
+        return m
+
+    def unmount_ec_shards(self, volume_id: int, shard_ids: list[int],
+                          collection: str = "") -> None:
+        m = self.ec_mounts.get((collection, volume_id))
+        if m is None:
+            return
+        m.shard_ids.difference_update(shard_ids)
+        if not m.shard_ids:
+            del self.ec_mounts[(collection, volume_id)]
+
+    # -- status / heartbeat ----------------------------------------------
+
+    def status(self) -> dict:
+        """Snapshot for heartbeats (§3.4): normal volumes + EC shard bits,
+        the payload SendHeartbeat streams to the master."""
+        vols = []
+        for (col, vid), v in sorted(self.volumes.items()):
+            vols.append({
+                "id": vid, "collection": col,
+                "size": v.dat_size, "file_count": v.nm.file_count,
+                "deleted_count": v.nm.deleted_count,
+                "read_only": False,
+                "replica_placement": str(v.super_block.replica_placement),
+                "version": v.super_block.version,
+            })
+        ec = [{"id": vid, "collection": col,
+               "ec_index_bits": m.shard_bits.bits}
+              for (col, vid), m in sorted(self.ec_mounts.items())]
+        return {"volumes": vols, "ec_shards": ec}

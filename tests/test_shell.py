@@ -1,0 +1,212 @@
+"""Shell command surface: ec.encode/decode/rebuild/balance round-trips."""
+
+import io
+import subprocess
+import sys
+
+import pytest
+
+from seaweedfs_tpu.shell.commands import (CommandEnv, ShellError,
+                                          run_command)
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume, generate_synthetic_volume
+
+
+def make_env(dirs) -> CommandEnv:
+    store = Store([str(d) for d in dirs])
+    store.load_existing()
+    return CommandEnv(store=store, out=io.StringIO())
+
+
+@pytest.fixture
+def env_with_volume(tmp_path):
+    v = generate_synthetic_volume(tmp_path / "3", 3, n_needles=20,
+                                  avg_size=256, seed=5)
+    needles = {i: v.read_needle(i).data for i in range(1, 21)}
+    v.close()
+    env = make_env([tmp_path])
+    yield env, tmp_path, needles
+    env.store.close()
+
+
+def test_ec_encode_then_decode_roundtrip(env_with_volume):
+    env, d, needles = env_with_volume
+    orig_dat = (d / "3.dat").read_bytes()
+    run_command(env, "ec.encode -volumeId 3")
+    assert not (d / "3.dat").exists()          # source deleted
+    assert (d / "3.ec00").exists() and (d / "3.ec13").exists()
+    assert (d / "3.ecx").exists() and (d / "3.vif").exists()
+    run_command(env, "ec.decode -volumeId 3")
+    assert (d / "3.dat").read_bytes() == orig_dat
+    assert not (d / "3.ec00").exists()         # EC artifacts dropped
+    v = env.store.get_volume(3)
+    for key, data in needles.items():
+        assert v.read_needle(key).data == data
+
+
+def test_ec_rebuild_after_shard_loss(env_with_volume):
+    env, d, needles = env_with_volume
+    run_command(env, "ec.encode -volumeId 3")
+    lost = [0, 5, 10, 13]
+    originals = {i: (d / f"3.ec{i:02d}").read_bytes() for i in lost}
+    for i in lost:
+        (d / f"3.ec{i:02d}").unlink()
+    env.store.unmount_ec_shards(3, lost)
+    run_command(env, "ec.rebuild")
+    for i in lost:
+        assert (d / f"3.ec{i:02d}").read_bytes() == originals[i]
+    assert env.store.ec_mounts[("", 3)].shard_bits.count() == 14
+
+
+def test_ec_encode_keep_source_and_custom_scheme(tmp_path):
+    v = generate_synthetic_volume(tmp_path / "7", 7, n_needles=5,
+                                  avg_size=128)
+    v.close()
+    env = make_env([tmp_path])
+    run_command(env, "ec.encode -volumeId 7 -keepSource -scheme 6,3")
+    assert (tmp_path / "7.dat").exists()
+    assert (tmp_path / "7.ec08").exists()
+    assert not (tmp_path / "7.ec09").exists()  # only 9 shards for (6,3)
+    env.store.close()
+
+
+def test_ec_balance_spreads_shards(tmp_path):
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(); d2.mkdir()
+    v = generate_synthetic_volume(d1 / "4", 4, n_needles=6, avg_size=64)
+    v.close()
+    env = make_env([d1, d2])
+    run_command(env, "ec.encode -volumeId 4")
+    run_command(env, "ec.balance")
+    in_d1 = ec_files.present_shards(d1 / "4")
+    in_d2 = ec_files.present_shards(d2 / "4")
+    assert len(in_d1) == len(in_d2) == 7
+    assert sorted(in_d1 + in_d2) == list(range(14))
+    assert (d2 / "4.ecx").exists()  # index copied alongside moved shards
+    env.store.close()
+
+
+def test_balance_then_rebuild_and_decode_across_locations(tmp_path):
+    # Regression: after ec.balance spreads shards over locations,
+    # rebuild/decode must gather siblings across locations (§3.5's
+    # copy-local step), not fail with TooFewShards.
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(); d2.mkdir()
+    v = generate_synthetic_volume(d1 / "6", 6, n_needles=12, avg_size=200,
+                                  seed=9)
+    needles = {i: v.read_needle(i).data for i in range(1, 13)}
+    orig_dat = None
+    v.close()
+    orig_dat = (d1 / "6.dat").read_bytes()
+    env = make_env([d1, d2])
+    run_command(env, "ec.encode -volumeId 6")
+    run_command(env, "ec.balance")
+    # lose two shards, one per location
+    lost_a = ec_files.present_shards(d1 / "6")[0]
+    lost_b = ec_files.present_shards(d2 / "6")[0]
+    (d1 / f"6.ec{lost_a:02d}").unlink()
+    (d2 / f"6.ec{lost_b:02d}").unlink()
+    run_command(env, "ec.rebuild -volumeId 6")
+    paths = env.store.ec_shard_paths(6)
+    assert sorted(paths) == list(range(14))
+    run_command(env, "ec.decode -volumeId 6")
+    assert (d1 / "6.dat").read_bytes() == orig_dat
+    # no EC artifacts (files or symlinks) left anywhere
+    leftovers = [p for d in (d1, d2) for p in d.iterdir()
+                 if ".ec" in p.name or p.suffix == ".vif"]
+    assert leftovers == []
+    vol = env.store.get_volume(6)
+    for key, data in needles.items():
+        assert vol.read_needle(key).data == data
+    env.store.close()
+
+
+def test_balance_after_gather_preserves_shards(tmp_path):
+    # Regression: gather leaves symlink caches at the primary base; a
+    # later ec.balance must not rename a symlink over its own real target
+    # (which would destroy the shard), and repeated balances must be
+    # idempotent per volume.
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(); d2.mkdir()
+    v = generate_synthetic_volume(d1 / "2", 2, n_needles=10, avg_size=128,
+                                  seed=4)
+    orig = {i: v.read_needle(i).data for i in range(1, 11)}
+    v.close()
+    env = make_env([d1, d2])
+    run_command(env, "ec.encode -volumeId 2")
+    run_command(env, "ec.balance")
+    run_command(env, "ec.rebuild")     # creates symlink caches via gather
+    run_command(env, "ec.balance")     # must not destroy real shards
+    real = env.store.ec_shard_paths(2)
+    assert sorted(real) == list(range(14))
+    for p in real.values():
+        assert p.exists() and not p.is_symlink()
+        assert p.stat().st_size > 0
+    run_command(env, "ec.decode -volumeId 2")
+    vol = env.store.get_volume(2)
+    for key, data in orig.items():
+        assert vol.read_needle(key).data == data
+    env.store.close()
+
+
+def test_decode_after_keep_source_closes_old_handle(tmp_path):
+    # Regression: ec.decode must close a still-registered Volume before
+    # replacing it in the registry.
+    v = generate_synthetic_volume(tmp_path / "5", 5, n_needles=6,
+                                  avg_size=64)
+    v.close()
+    env = make_env([tmp_path])
+    run_command(env, "ec.encode -volumeId 5 -keepSource")
+    old = env.store.volumes[("", 5)]
+    run_command(env, "ec.decode -volumeId 5")
+    assert old._dat is None            # closed, not leaked
+    assert env.store.volumes[("", 5)] is not old
+    env.store.close()
+
+
+def test_gather_with_relative_dirs(tmp_path, monkeypatch):
+    # Regression: gather_ec_volume's symlinks must use absolute targets;
+    # with relative -dir paths a relative link dangles (resolves against
+    # the location directory, not the cwd).
+    (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir()
+    v = generate_synthetic_volume(tmp_path / "a" / "12", 12, n_needles=5,
+                                  avg_size=64)
+    v.close()
+    monkeypatch.chdir(tmp_path)
+    env = make_env(["a", "b"])
+    run_command(env, "ec.encode -volumeId 12")
+    run_command(env, "ec.balance")
+    run_command(env, "ec.rebuild")          # must not TooFewShards
+    run_command(env, "ec.decode -volumeId 12")
+    assert (tmp_path / "a" / "12.dat").exists()
+    env.store.close()
+
+
+def test_volume_list_and_errors(env_with_volume):
+    env, d, _ = env_with_volume
+    run_command(env, "volume.list")
+    assert "volume 3" in env.out.getvalue()
+    with pytest.raises(ShellError):
+        run_command(env, "ec.encode -volumeId 99")
+    with pytest.raises(ShellError):
+        run_command(env, "nonsense.command")
+    with pytest.raises(ShellError):
+        run_command(env, "ec.encode")  # missing -volumeId
+
+
+def test_cli_oneshot_subprocess(tmp_path):
+    v = generate_synthetic_volume(tmp_path / "8", 8, n_needles=4,
+                                  avg_size=64)
+    v.close()
+    r = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", "shell", "-dir",
+         str(tmp_path), "-c", "ec.encode -volumeId 8"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "8.ec13").exists()
+    r2 = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", "shell", "-dir",
+         str(tmp_path), "-c", "volume.list"],
+        capture_output=True, text=True, timeout=600)
+    assert "ec volume 8" in r2.stdout

@@ -1,0 +1,97 @@
+"""Store layer: disk locations, volume registry, EC mounts, heartbeat."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.pipeline.encode import encode_volume
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import (Store, StoreError, parse_base_name,
+                                         volume_base_name)
+from seaweedfs_tpu.storage.volume import generate_synthetic_volume
+
+
+def test_base_name_roundtrip():
+    assert volume_base_name(3) == "3"
+    assert volume_base_name(3, "pics") == "pics_3"
+    assert parse_base_name("3") == ("", 3)
+    assert parse_base_name("pics_3") == ("pics", 3)
+    assert parse_base_name("a_b_7") == ("a_b", 7)
+    with pytest.raises(ValueError):
+        parse_base_name("nodigits")
+
+
+def test_store_create_write_read_delete(tmp_path):
+    st = Store([tmp_path])
+    st.create_volume(1)
+    off = st.write_needle(1, Needle(cookie=7, id=42, data=b"hello"))
+    assert off == 8  # first record lands right after the superblock
+    n = st.read_needle(1, 42, cookie=7)
+    assert n.data == b"hello"
+    assert st.delete_needle(1, 42)
+    with pytest.raises(KeyError):
+        st.read_needle(1, 42)
+    st.close()
+
+
+def test_store_load_existing_and_heartbeat(tmp_path):
+    v = generate_synthetic_volume(tmp_path / "5", 5, n_needles=10,
+                                  avg_size=64)
+    v.close()
+    st = Store([tmp_path])
+    st.load_existing()
+    assert st.has_volume(5)
+    status = st.status()
+    assert status["volumes"][0]["id"] == 5
+    assert status["volumes"][0]["file_count"] == 10
+    assert status["ec_shards"] == []
+    st.close()
+
+
+def test_store_two_locations_balance(tmp_path):
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(); d2.mkdir()
+    st = Store([d1, d2], max_volumes=2)
+    for vid in range(1, 5):
+        st.create_volume(vid)
+    # 4 volumes over 2 locations with capacity 2 each: both full.
+    with pytest.raises(StoreError):
+        st.create_volume(99)
+    by_dir = {}
+    for v in st.volumes.values():
+        by_dir.setdefault(v.base.parent.name, 0)
+        by_dir[v.base.parent.name] += 1
+    assert sorted(by_dir.values()) == [2, 2]
+    st.close()
+
+
+def test_store_ec_mount_cycle(tmp_path):
+    v = generate_synthetic_volume(tmp_path / "9", 9, n_needles=8,
+                                  avg_size=128)
+    v.close()
+    encode_volume(tmp_path / "9", remove_source=True)
+    st = Store([tmp_path])
+    st.load_existing()
+    assert not st.has_volume(9)
+    m = st.ec_mounts[("", 9)]
+    assert m.shard_bits.count() == 14
+    st.unmount_ec_shards(9, [0, 1])
+    assert st.ec_mounts[("", 9)].shard_bits.count() == 12
+    st.mount_ec_shards(9, [0, 1])
+    assert st.ec_mounts[("", 9)].shard_bits.count() == 14
+    hb = st.status()
+    assert hb["ec_shards"][0]["ec_index_bits"] == (1 << 14) - 1
+    with pytest.raises(StoreError):
+        st.mount_ec_shards(77, [0])
+    st.close()
+
+
+def test_store_delete_volume_removes_files(tmp_path):
+    st = Store([tmp_path])
+    st.create_volume(2, collection="col")
+    st.write_needle(2, Needle(cookie=1, id=1, data=b"x"), collection="col")
+    st.delete_volume(2, collection="col")
+    assert not (tmp_path / "col_2.dat").exists()
+    assert not (tmp_path / "col_2.idx").exists()
+    assert not st.has_volume(2, collection="col")
+    st.close()
