@@ -3,9 +3,10 @@
 Mirrors weed/weed.go + weed/command/command.go (SURVEY.md §2 "CLI
 dispatcher"): a table of subcommands, each owning its flags:
 
-    python -m seaweedfs_tpu shell  -dir ...      admin shell (REPL / -c)
-    python -m seaweedfs_tpu ...                  (servers land with the
-                                                  gRPC layer)
+    python -m seaweedfs_tpu master -port 9333                control plane
+    python -m seaweedfs_tpu volume -dir d -mserver host:port data plane
+    python -m seaweedfs_tpu shell  -dir ... | -master ...    admin shell
+    python -m seaweedfs_tpu scaffold -config security        config template
 """
 
 from __future__ import annotations
@@ -18,8 +19,32 @@ def _run_shell(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_master(argv: list[str]) -> int:
+    from .cluster.master import main
+    return main(argv)
+
+
+def _run_volume(argv: list[str]) -> int:
+    from .cluster.volume_server import main
+    return main(argv)
+
+
+def _run_scaffold(argv: list[str]) -> int:
+    import argparse
+
+    from .util import config
+    p = argparse.ArgumentParser(prog="scaffold")
+    p.add_argument("-config", required=True)
+    args = p.parse_args(argv)
+    print(config.scaffold(args.config), end="")
+    return 0
+
+
 COMMANDS = {
     "shell": _run_shell,
+    "master": _run_master,
+    "volume": _run_volume,
+    "scaffold": _run_scaffold,
 }
 
 

@@ -1,0 +1,8 @@
+"""Cluster layer: master control plane, volume-server data plane, clients.
+
+Mirrors the reference's process topology (SURVEY.md §1 L2/L3): a master
+tracks DC -> rack -> data-node -> volume/EC-shard state fed by heartbeat
+streams and hands out file ids; volume servers own Stores and execute
+data-plane HTTP plus admin gRPC (the EC rpc family); thin client libraries
+(operation, wdclient) wrap the two.
+"""

@@ -1,0 +1,401 @@
+"""Master server: control plane over gRPC + HTTP.
+
+Mirrors weed/server/master_server.go + master_grpc_server.go (SURVEY.md §2
+"weed master", §3.4): volume servers stream heartbeats in and get
+leader/size-limit back; clients assign file ids (``/dir/assign``, gRPC
+``Assign``) and look volumes up (``/dir/lookup``, ``LookupVolume``,
+``LookupEcVolume``). When an assign finds no writable volume the master
+grows one — picks replica targets off the topology and calls
+``AllocateVolume`` on each (volume_growth.go's
+``GrowByCountAndType``). A single process is always leader: the
+reference's Raft election exists to pick one master among many; the build
+runs one master per cluster and reports itself leader (raft_server.go's
+observable behavior, minus the consensus protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .. import pb
+from ..pb import master_pb2, volume_server_pb2
+from ..storage.superblock import ReplicaPlacement
+from ..storage.types import FileId
+from ..util import config as config_mod
+from ..util import glog
+from ..util import security
+from ..util.stats import Metrics
+from .sequence import MemorySequencer
+from .topology import Topology, TopologyError, VolumeInfo
+
+
+def _grpc_port(http_port: int) -> int:
+    """The reference convention: gRPC port = HTTP port + 10000."""
+    return http_port + 10000
+
+
+class MasterServer:
+    def __init__(self, ip: str = "127.0.0.1", port: int = 9333,
+                 volume_size_limit_mb: int = 30 * 1024,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 5.0,
+                 sequencer: Optional[MemorySequencer] = None,
+                 secret: str = "", seed: Optional[int] = None):
+        self.ip = ip
+        self.port = port
+        self.url = f"{ip}:{port}"
+        self.topology = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds, seed=seed)
+        self.sequencer = sequencer or MemorySequencer()
+        self.default_replication = default_replication
+        self.guard = security.Guard(secret)
+        self.metrics = Metrics(namespace="master")
+        self._channels: dict[str, object] = {}
+        self._grpc_server = None
+        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._reaper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._grow_lock = threading.Lock()
+
+    # ------------- lifecycle -------------
+
+    def start(self) -> "MasterServer":
+        import grpc
+
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16))
+        self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
+            pb.MASTER_SERVICE, pb.MASTER_METHODS, _MasterServicer(self)),))
+        bound = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{_grpc_port(self.port)}")
+        if bound == 0:
+            raise RuntimeError(
+                f"cannot bind master grpc port {_grpc_port(self.port)}")
+        self._grpc_server.start()
+
+        handler = _make_http_handler(self)
+        self._http_server = ThreadingHTTPServer((self.ip, self.port), handler)
+        self._http_thread = threading.Thread(
+            target=self._http_server.serve_forever, daemon=True,
+            name=f"master-http-{self.port}")
+        self._http_thread.start()
+
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name=f"master-reaper-{self.port}")
+        self._reaper.start()
+        glog.info("master started at %s (grpc %d)", self.url,
+                  _grpc_port(self.port))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+
+    def __enter__(self) -> "MasterServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(self.topology.pulse_seconds):
+            dead = self.topology.reap_dead_nodes()
+            for url in dead:
+                glog.warning("master: data node %s missed heartbeats, "
+                             "removed from topology", url)
+
+    # ------------- volume-server dialing -------------
+
+    def _volume_stub(self, node_url: str) -> pb.Stub:
+        import grpc
+
+        ch = self._channels.get(node_url)
+        if ch is None:
+            ip, http_port = node_url.rsplit(":", 1)
+            ch = grpc.insecure_channel(f"{ip}:{_grpc_port(int(http_port))}")
+            self._channels[node_url] = ch
+        return pb.volume_stub(ch)
+
+    # ------------- core ops -------------
+
+    def grow_volume(self, collection: str = "",
+                    replication: Optional[str] = None,
+                    ttl: str = "") -> int:
+        """Allocate one new volume on replica-placement-chosen nodes."""
+        replication = replication or self.default_replication
+        with self._grow_lock:
+            targets = self.topology.pick_grow_targets(replication)
+            vid = self.topology.next_volume_id()
+            for node in targets:
+                self._volume_stub(node.url).AllocateVolume(
+                    volume_server_pb2.AllocateVolumeRequest(
+                        volume_id=vid, collection=collection,
+                        replication=replication, ttl=ttl))
+                # Optimistic registration so the volume is writable now;
+                # the next heartbeat snapshot confirms it.
+                self.topology.register_volume(node.url, VolumeInfo(
+                    id=vid, collection=collection,
+                    replica_placement=replication, ttl=ttl))
+            glog.info("master: grew volume %d on %s", vid,
+                      [n.url for n in targets])
+            return vid
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: Optional[str] = None, ttl: str = "") -> dict:
+        replication = replication or self.default_replication
+        self.metrics.counter("assign_requests").inc()
+        for _attempt in (0, 1):
+            try:
+                vid, nodes = self.topology.pick_for_write(
+                    collection, replication, ttl)
+                break
+            except TopologyError:
+                if _attempt:
+                    raise
+                self.grow_volume(collection, replication, ttl)
+        key = self.sequencer.next_batch(max(1, count))
+        fid = str(FileId(volume_id=vid, key=key,
+                         cookie=security.new_cookie()))
+        node = nodes[0]
+        return {"fid": fid, "url": node.url,
+                "publicUrl": node.public_url or node.url,
+                "count": max(1, count),
+                "auth": self.guard.sign(fid)}
+
+    def lookup(self, volume_id: int, collection: str = "") -> list[dict]:
+        nodes = self.topology.lookup_volume(volume_id, collection)
+        if not nodes:
+            # EC volumes answer lookups too (any node with a shard).
+            by_shard = self.topology.lookup_ec_volume(volume_id)
+            seen: dict[str, dict] = {}
+            for node_list in by_shard.values():
+                for n in node_list:
+                    seen[n.url] = {"url": n.url,
+                                   "publicUrl": n.public_url or n.url}
+            return list(seen.values())
+        return [{"url": n.url, "publicUrl": n.public_url or n.url}
+                for n in nodes]
+
+
+class _MasterServicer:
+    """gRPC service impl bound via pb.generic_handler."""
+
+    def __init__(self, ms: MasterServer):
+        self.ms = ms
+
+    def SendHeartbeat(self, request_iterator, context):
+        ms = self.ms
+        for hb in request_iterator:
+            url = f"{hb.ip}:{hb.port}"
+            volumes = [VolumeInfo(
+                id=v.id, collection=v.collection, size=v.size,
+                file_count=v.file_count, delete_count=v.delete_count,
+                deleted_byte_count=v.deleted_byte_count,
+                read_only=v.read_only,
+                replica_placement=str(
+                    ReplicaPlacement.from_byte(v.replica_placement)),
+                version=v.version or 3,
+                ttl="" if not v.ttl else str(v.ttl),
+            ) for v in hb.volumes]
+            ec = [(s.collection, s.id, s.ec_index_bits)
+                  for s in hb.ec_shards]
+            ms.topology.register_heartbeat(
+                url, public_url=hb.public_url,
+                data_center=hb.data_center, rack=hb.rack,
+                max_volume_count=hb.max_volume_count or 8,
+                volumes=volumes, ec_shards=ec)
+            if hb.max_file_key:
+                ms.sequencer.set_max(hb.max_file_key)
+            yield master_pb2.HeartbeatResponse(
+                volume_size_limit=ms.topology.volume_size_limit,
+                leader=ms.url)
+
+    def Assign(self, request, context):
+        try:
+            r = self.ms.assign(count=request.count or 1,
+                               collection=request.collection,
+                               replication=request.replication or None,
+                               ttl=request.ttl)
+        except (TopologyError, ValueError) as e:
+            return master_pb2.AssignResponse(error=str(e))
+        return master_pb2.AssignResponse(
+            fid=r["fid"], url=r["url"], public_url=r["publicUrl"],
+            count=r["count"], auth=r["auth"])
+
+    def LookupVolume(self, request, context):
+        resp = master_pb2.LookupVolumeResponse()
+        for vid_str in request.volume_ids:
+            entry = resp.volume_id_locations.add()
+            entry.volume_id = vid_str
+            try:
+                vid = int(vid_str.split(",")[0])
+            except ValueError:
+                entry.error = f"bad volume id {vid_str!r}"
+                continue
+            locs = self.ms.lookup(vid, request.collection)
+            if not locs:
+                entry.error = f"volume {vid} not found"
+            for loc in locs:
+                entry.locations.add(url=loc["url"],
+                                    public_url=loc["publicUrl"])
+        return resp
+
+    def LookupEcVolume(self, request, context):
+        resp = master_pb2.LookupEcVolumeResponse(
+            volume_id=request.volume_id)
+        for sid, nodes in sorted(
+                self.ms.topology.lookup_ec_volume(
+                    request.volume_id).items()):
+            entry = resp.shard_id_locations.add(shard_id=sid)
+            for n in nodes:
+                entry.locations.add(url=n.url,
+                                    public_url=n.public_url or n.url)
+        return resp
+
+    def VolumeList(self, request, context):
+        resp = master_pb2.VolumeListResponse(
+            volume_size_limit_mb=self.ms.topology.volume_size_limit
+            // (1024 * 1024))
+        topo = resp.topology_info
+        topo.id = "topo"
+        by_dc: dict[str, dict[str, list]] = {}
+        for n in self.ms.topology.snapshot_nodes():
+            by_dc.setdefault(n.data_center, {}).setdefault(
+                n.rack, []).append(n)
+        for dc, racks in sorted(by_dc.items()):
+            dci = topo.data_center_infos.add(id=dc)
+            for rack, nodes in sorted(racks.items()):
+                ri = dci.rack_infos.add(id=rack)
+                for n in nodes:
+                    dni = ri.data_node_infos.add(
+                        id=n.url, volume_count=n.volume_count,
+                        max_volume_count=n.max_volume_count,
+                        free_volume_count=n.free_slots,
+                        active_volume_count=n.volume_count)
+                    for v in n.volumes.values():
+                        dni.volume_infos.add(
+                            id=v.id, size=v.size, collection=v.collection,
+                            file_count=v.file_count,
+                            delete_count=v.delete_count,
+                            deleted_byte_count=v.deleted_byte_count,
+                            read_only=v.read_only,
+                            replica_placement=ReplicaPlacement.parse(
+                                v.replica_placement).to_byte(),
+                            version=v.version)
+                    for (col, vid), bits in n.ec_shards.items():
+                        dni.ec_shard_infos.add(
+                            id=vid, collection=col, ec_index_bits=bits.bits)
+        return resp
+
+    def GetMasterConfiguration(self, request, context):
+        return master_pb2.GetMasterConfigurationResponse(
+            volume_size_limit=self.ms.topology.volume_size_limit,
+            jwt_enabled=self.ms.guard.enabled)
+
+
+def _make_http_handler(ms: MasterServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route through glog
+            glog.v(2, "master http: " + fmt, *args)
+
+        def _json(self, obj, code: int = 200) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            try:
+                if u.path == "/dir/assign":
+                    self._json(ms.assign(
+                        count=int(q.get("count", 1)),
+                        collection=q.get("collection", ""),
+                        replication=q.get("replication") or None,
+                        ttl=q.get("ttl", "")))
+                elif u.path == "/dir/lookup":
+                    vid = int(str(q.get("volumeId", "0")).split(",")[0])
+                    locs = ms.lookup(vid, q.get("collection", ""))
+                    if not locs:
+                        self._json({"volumeId": str(vid),
+                                    "error": "volume not found"}, 404)
+                    else:
+                        self._json({"volumeId": str(vid),
+                                    "locations": locs})
+                elif u.path in ("/cluster/status", "/dir/status"):
+                    self._json({"IsLeader": True, "Leader": ms.url,
+                                "Topology": ms.topology.to_map()})
+                elif u.path == "/metrics":
+                    body = ms.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json({"error": "not found"}, 404)
+            except (TopologyError, ValueError) as e:
+                self._json({"error": str(e)}, 500)
+
+        def do_POST(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            if u.path == "/vol/grow":
+                try:
+                    n = int(q.get("count", 1))
+                    vids = [ms.grow_volume(
+                        q.get("collection", ""),
+                        q.get("replication") or None,
+                        q.get("ttl", "")) for _ in range(n)]
+                    self._json({"count": len(vids), "volumeIds": vids})
+                except (TopologyError, ValueError) as e:
+                    self._json({"error": str(e)}, 500)
+            else:
+                self.do_GET()
+
+    return Handler
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """``python -m seaweedfs_tpu master`` entry (weed/command/master.go)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="master")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-config", default="")
+    args = p.parse_args(argv)
+    conf = config_mod.load(args.config) if args.config else {}
+    secret = config_mod.lookup(conf, "jwt.signing.key", "")
+    ms = MasterServer(ip=args.ip, port=args.port,
+                      volume_size_limit_mb=args.volumeSizeLimitMB,
+                      default_replication=args.defaultReplication,
+                      pulse_seconds=args.pulseSeconds, secret=secret)
+    ms.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ms.stop()
+    return 0

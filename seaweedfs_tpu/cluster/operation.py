@@ -1,0 +1,107 @@
+"""Client SDK: assign -> upload -> lookup -> delete, and submit.
+
+Mirrors weed/operation (SURVEY.md §2 "Operation client lib", §3.2 write
+call stack): ``assign`` asks the master for a file id + target server,
+``upload`` POSTs the bytes (with the master-issued JWT), ``submit`` does
+both for a batch of files, ``lookup``/``download`` resolve and fetch,
+``delete`` removes everywhere. These are what the CLI upload/download
+commands, the filer, and the benchmark harness use.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+from .wdclient import MasterClient
+
+
+class OperationError(RuntimeError):
+    pass
+
+
+@dataclass
+class AssignResult:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+    auth: str = ""
+
+
+def assign(master: MasterClient, count: int = 1, collection: str = "",
+           replication: str = "", ttl: str = "") -> AssignResult:
+    r = master.assign(count=count, collection=collection,
+                      replication=replication, ttl=ttl)
+    return AssignResult(fid=r["fid"], url=r["url"],
+                        public_url=r["publicUrl"] or r["url"],
+                        count=r["count"], auth=r.get("auth", ""))
+
+
+def upload(server_url: str, fid: str, data: bytes, jwt: str = "",
+           collection: str = "") -> dict:
+    url = f"http://{server_url}/{fid}"
+    if collection:
+        url += f"?collection={collection}"
+    req = urllib.request.Request(url, data=data, method="POST")
+    if jwt:
+        req.add_header("Authorization", f"BEARER {jwt}")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        raise OperationError(
+            f"upload to {url} failed: {e.code} {e.read()!r}") from e
+
+
+def download(master: MasterClient, fid: str,
+             collection: str = "") -> bytes:
+    vid = int(fid.split(",")[0])
+    locs = master.lookup(vid, collection)
+    if not locs:
+        raise OperationError(f"volume {vid} has no locations")
+    last: Optional[Exception] = None
+    for loc in locs:
+        url = f"http://{loc['url']}/{fid}"
+        if collection:
+            url += f"?collection={collection}"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                return resp.read()
+        except urllib.error.URLError as e:
+            last = e
+    raise OperationError(f"download {fid} failed: {last}")
+
+
+def delete(master: MasterClient, fid: str, jwt: str = "",
+           collection: str = "") -> None:
+    vid = int(fid.split(",")[0])
+    for loc in master.lookup(vid, collection):
+        url = f"http://{loc['url']}/{fid}"
+        if collection:
+            url += f"?collection={collection}"
+        req = urllib.request.Request(url, method="DELETE")
+        if jwt:
+            req.add_header("Authorization", f"BEARER {jwt}")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+            return  # the server fans the delete out to replicas
+        except urllib.error.URLError:
+            continue
+    raise OperationError(f"delete {fid} failed on every location")
+
+
+def submit(master: MasterClient, blobs: list[bytes],
+           collection: str = "", replication: str = "",
+           ttl: str = "") -> list[str]:
+    """SubmitFiles: one assign per blob, then upload; returns fids."""
+    fids = []
+    for blob in blobs:
+        a = assign(master, 1, collection, replication, ttl)
+        upload(a.url, a.fid, blob, jwt=a.auth, collection=collection)
+        fids.append(a.fid)
+    return fids

@@ -1,0 +1,341 @@
+"""Master-side in-memory cluster topology.
+
+Mirrors weed/topology/ (SURVEY.md §2 "Topology"): a DC -> rack -> data-node
+tree rebuilt from heartbeat snapshots, per-(collection, replication, ttl)
+volume layouts that track which volumes are writable and where replicas
+live, and EC shard location maps (topology_ec.go's EcShardLocations).
+``pick_for_write`` implements volume_layout.go's writable-volume choice;
+``pick_grow_targets`` is the placement half of volume_growth.go —
+replica targets spread across data centers / racks / nodes according to
+the replica-placement code (e.g. ``010`` = one extra copy on a different
+rack, same DC).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from ..storage.ec_files import ShardBits
+from ..storage.superblock import ReplicaPlacement, Ttl
+
+
+@dataclass
+class VolumeInfo:
+    """One volume replica as reported by a heartbeat."""
+    id: int
+    collection: str = ""
+    size: int = 0
+    file_count: int = 0
+    delete_count: int = 0
+    deleted_byte_count: int = 0
+    read_only: bool = False
+    replica_placement: str = "000"
+    version: int = 3
+    ttl: str = ""
+
+
+@dataclass
+class DataNode:
+    url: str                     # "ip:port" — the node id
+    public_url: str = ""
+    data_center: str = "DefaultDataCenter"
+    rack: str = "DefaultRack"
+    max_volume_count: int = 8
+    volumes: dict[tuple[str, int], VolumeInfo] = field(default_factory=dict)
+    ec_shards: dict[tuple[str, int], ShardBits] = field(default_factory=dict)
+    last_seen: float = field(default_factory=time.time)
+
+    @property
+    def volume_count(self) -> int:
+        return len(self.volumes)
+
+    @property
+    def ec_shard_count(self) -> int:
+        return sum(b.count() for b in self.ec_shards.values())
+
+    @property
+    def free_slots(self) -> int:
+        # The reference charges EC shards fractionally; one volume ==
+        # one slot, ec shards count at shards/total granularity.
+        return max(0, self.max_volume_count - self.volume_count
+                   - (self.ec_shard_count + 13) // 14)
+
+
+class TopologyError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class LayoutKey:
+    collection: str
+    replication: str
+    ttl: str
+
+
+class VolumeLayout:
+    """Tracks volumes of one (collection, replication, ttl) class."""
+
+    def __init__(self, key: LayoutKey):
+        self.key = key
+        self.locations: dict[int, set[str]] = {}       # vid -> node urls
+        self.readonly: set[int] = set()
+        self.sizes: dict[int, int] = {}
+
+    def writable(self, volume_size_limit: int) -> list[int]:
+        rp = ReplicaPlacement.parse(self.key.replication)
+        return [vid for vid, urls in self.locations.items()
+                if vid not in self.readonly
+                and len(urls) >= rp.copy_count()
+                and self.sizes.get(vid, 0) < volume_size_limit]
+
+
+class Topology:
+    """The whole tree + layouts + EC shard map. Thread-safe."""
+
+    def __init__(self, volume_size_limit: int = 30 * 1024 ** 3,
+                 pulse_seconds: float = 5.0, seed: Optional[int] = None):
+        self._lock = threading.RLock()
+        self.nodes: dict[str, DataNode] = {}
+        self.layouts: dict[LayoutKey, VolumeLayout] = {}
+        # vid -> {shard_id -> set of node urls}; collection in ec_collections
+        self.ec_locations: dict[int, dict[int, set[str]]] = {}
+        self.ec_collections: dict[int, str] = {}
+        self.volume_size_limit = volume_size_limit
+        self.pulse_seconds = pulse_seconds
+        self.max_volume_id = 0
+        self._rng = random.Random(seed)
+
+    # ---------------- heartbeat ingestion ----------------
+
+    def register_heartbeat(self, url: str, *, public_url: str = "",
+                           data_center: str = "", rack: str = "",
+                           max_volume_count: int = 8,
+                           volumes: Iterable[VolumeInfo] = (),
+                           ec_shards: Iterable[tuple[str, int, int]] = (),
+                           ) -> DataNode:
+        """Full-snapshot update of one node (SURVEY.md §3.4).
+
+        ``ec_shards`` items are (collection, volume_id, ec_index_bits).
+        """
+        with self._lock:
+            node = self.nodes.get(url)
+            if node is None:
+                node = DataNode(url=url)
+                self.nodes[url] = node
+            node.public_url = public_url or url
+            if data_center:
+                node.data_center = data_center
+            if rack:
+                node.rack = rack
+            node.max_volume_count = max_volume_count
+            node.last_seen = time.time()
+            node.volumes = {(v.collection, v.id): v for v in volumes}
+            node.ec_shards = {(c, vid): ShardBits(bits)
+                              for (c, vid, bits) in ec_shards}
+            for v in node.volumes.values():
+                self.max_volume_id = max(self.max_volume_id, v.id)
+            for (_c, vid) in node.ec_shards:
+                self.max_volume_id = max(self.max_volume_id, vid)
+            self._rebuild_indexes()
+            return node
+
+    def register_volume(self, url: str, info: VolumeInfo) -> None:
+        """Record one freshly-allocated volume on a node immediately
+        (optimistic registration after AllocateVolume; the next full
+        heartbeat snapshot confirms it)."""
+        with self._lock:
+            node = self.nodes.get(url)
+            if node is None:
+                raise TopologyError(f"unknown data node {url}")
+            node.volumes[(info.collection, info.id)] = info
+            self.max_volume_id = max(self.max_volume_id, info.id)
+            self._rebuild_indexes()
+
+    def snapshot_nodes(self) -> list[DataNode]:
+        """Stable list of nodes for iteration outside the lock."""
+        with self._lock:
+            return list(self.nodes.values())
+
+    def unregister(self, url: str) -> None:
+        with self._lock:
+            if self.nodes.pop(url, None) is not None:
+                self._rebuild_indexes()
+
+    def reap_dead_nodes(self, timeout: Optional[float] = None) -> list[str]:
+        """Drop nodes whose heartbeats stopped (the failure detector)."""
+        timeout = timeout if timeout is not None else 5 * self.pulse_seconds
+        now = time.time()
+        with self._lock:
+            dead = [u for u, n in self.nodes.items()
+                    if now - n.last_seen > timeout]
+            for u in dead:
+                del self.nodes[u]
+            if dead:
+                self._rebuild_indexes()
+            return dead
+
+    def _rebuild_indexes(self) -> None:
+        layouts: dict[LayoutKey, VolumeLayout] = {}
+        ec_locs: dict[int, dict[int, set[str]]] = {}
+        ec_cols: dict[int, str] = {}
+        for node in self.nodes.values():
+            for v in node.volumes.values():
+                key = LayoutKey(v.collection, v.replica_placement, v.ttl)
+                lay = layouts.setdefault(key, VolumeLayout(key))
+                lay.locations.setdefault(v.id, set()).add(node.url)
+                lay.sizes[v.id] = max(lay.sizes.get(v.id, 0), v.size)
+                if v.read_only:
+                    lay.readonly.add(v.id)
+            for (col, vid), bits in node.ec_shards.items():
+                shard_map = ec_locs.setdefault(vid, {})
+                ec_cols[vid] = col
+                for sid in bits.ids():
+                    shard_map.setdefault(sid, set()).add(node.url)
+        self.layouts = layouts
+        self.ec_locations = ec_locs
+        self.ec_collections = ec_cols
+
+    # ---------------- lookups ----------------
+
+    def lookup_volume(self, volume_id: int, collection: str = ""
+                      ) -> list[DataNode]:
+        with self._lock:
+            urls: set[str] = set()
+            for key, lay in self.layouts.items():
+                if collection and key.collection != collection:
+                    continue
+                urls |= lay.locations.get(volume_id, set())
+            return [self.nodes[u] for u in sorted(urls) if u in self.nodes]
+
+    def lookup_ec_volume(self, volume_id: int
+                         ) -> dict[int, list[DataNode]]:
+        with self._lock:
+            out: dict[int, list[DataNode]] = {}
+            for sid, urls in self.ec_locations.get(volume_id, {}).items():
+                out[sid] = [self.nodes[u] for u in sorted(urls)
+                            if u in self.nodes]
+            return out
+
+    def next_volume_id(self) -> int:
+        with self._lock:
+            self.max_volume_id += 1
+            return self.max_volume_id
+
+    # ---------------- write placement ----------------
+
+    def pick_for_write(self, collection: str = "", replication: str = "000",
+                       ttl: str = "") -> tuple[int, list[DataNode]]:
+        """A writable volume id + its replica nodes, or TopologyError."""
+        Ttl.parse(ttl)  # validate early
+        key = LayoutKey(collection, replication, ttl)
+        with self._lock:
+            lay = self.layouts.get(key)
+            if lay is None:
+                raise TopologyError(
+                    f"no writable volumes for {key} (grow first)")
+            writable = lay.writable(self.volume_size_limit)
+            if not writable:
+                raise TopologyError(
+                    f"no writable volumes for {key} (grow first)")
+            vid = self._rng.choice(writable)
+            return vid, [self.nodes[u] for u in sorted(lay.locations[vid])
+                         if u in self.nodes]
+
+    def pick_grow_targets(self, replication: str = "000",
+                          ) -> list[DataNode]:
+        """Placement for a brand-new volume's replicas.
+
+        volume_growth.go semantics: the replica-placement digits are
+        (other DCs, other racks same DC, other nodes same rack). Picks a
+        primary node with free slots, then satisfies each digit; raises
+        if the cluster can't.
+        """
+        rp = ReplicaPlacement.parse(replication)
+        with self._lock:
+            candidates = [n for n in self.nodes.values() if n.free_slots > 0]
+            if not candidates:
+                raise TopologyError("no data node with free slots")
+            self._rng.shuffle(candidates)
+            # Prefer least-loaded primary for balance.
+            candidates.sort(key=lambda n: n.volume_count)
+            for primary in candidates:
+                chosen = self._grow_from(primary, rp, candidates)
+                if chosen is not None:
+                    return chosen
+            raise TopologyError(
+                f"cannot satisfy replica placement {replication}")
+
+    def _grow_from(self, primary: DataNode, rp: ReplicaPlacement,
+                   candidates: list[DataNode]) -> Optional[list[DataNode]]:
+        chosen = [primary]
+
+        def ok_same_rack(n):
+            return (n.data_center == primary.data_center
+                    and n.rack == primary.rack and n is not primary)
+
+        def ok_other_rack(n):
+            return (n.data_center == primary.data_center
+                    and n.rack != primary.rack)
+
+        def ok_other_dc(n):
+            return n.data_center != primary.data_center
+
+        for count, pred in ((rp.same_rack, ok_same_rack),
+                            (rp.diff_rack, ok_other_rack),
+                            (rp.diff_dc, ok_other_dc)):
+            pool = [n for n in candidates if pred(n) and n not in chosen]
+            if len(pool) < count:
+                return None
+            chosen.extend(pool[:count])
+        return chosen
+
+    # ---------------- EC placement ----------------
+
+    def pick_ec_spread(self, total_shards: int,
+                       exclude: Iterable[str] = ()) -> list[DataNode]:
+        """Round-robin shard targets, racks first (command_ec_encode.go's
+        spread step): sort nodes by (ec load), interleave racks."""
+        with self._lock:
+            nodes = [n for n in self.nodes.values()
+                     if n.url not in set(exclude)]
+            if not nodes:
+                nodes = list(self.nodes.values())
+            if not nodes:
+                raise TopologyError("no data nodes for EC spread")
+            by_rack: dict[tuple[str, str], list[DataNode]] = {}
+            for n in sorted(nodes, key=lambda n: n.ec_shard_count):
+                by_rack.setdefault((n.data_center, n.rack), []).append(n)
+            racks = sorted(by_rack.values(),
+                           key=lambda ns: sum(n.ec_shard_count for n in ns))
+            out: list[DataNode] = []
+            i = 0
+            while len(out) < total_shards:
+                rack = racks[i % len(racks)]
+                out.append(rack[(i // len(racks)) % len(rack)])
+                i += 1
+            return out
+
+    # ---------------- status ----------------
+
+    def to_map(self) -> dict:
+        """JSON-able snapshot (master /cluster/status, /vol/status)."""
+        with self._lock:
+            dcs: dict[str, dict[str, list[dict]]] = {}
+            for n in self.nodes.values():
+                rackmap = dcs.setdefault(n.data_center, {})
+                rackmap.setdefault(n.rack, []).append({
+                    "Url": n.url, "PublicUrl": n.public_url,
+                    "Volumes": n.volume_count,
+                    "EcShards": n.ec_shard_count,
+                    "Max": n.max_volume_count,
+                })
+            return {
+                "Max": sum(n.max_volume_count for n in self.nodes.values()),
+                "Free": sum(n.free_slots for n in self.nodes.values()),
+                "DataCenters": dcs,
+                "MaxVolumeId": self.max_volume_id,
+            }

@@ -1,0 +1,753 @@
+"""Volume server: HTTP data plane + gRPC admin plane over a Store.
+
+Mirrors weed/server/volume_server*.go + volume_grpc_erasure_coding.go
+(SURVEY.md §2 "weed volume", "EC gRPC handlers", §3.1-§3.3): serves
+``GET/POST/DELETE /<vid>,<fid>`` against local volumes, falls through to
+EC shard reads (with interval reconstruction pulling remote shards over
+``VolumeEcShardRead``), fans replicated writes out to peer replicas, and
+executes the shell's EC choreography rpcs — generate (the TPU encode!),
+rebuild, copy (via ``CopyFile`` streaming from the source node), mount,
+unmount, to-volume. A background thread streams heartbeat snapshots to
+the master (§3.4).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from .. import pb
+from ..pb import master_pb2, volume_server_pb2
+from ..pipeline import decode as decode_mod
+from ..pipeline import encode as encode_mod
+from ..pipeline import rebuild as rebuild_mod
+from ..pipeline.read import EcVolumeReader
+from ..pipeline.scheme import DEFAULT_SCHEME, EcScheme
+from ..storage import ec_files
+from ..storage.needle import Needle
+from ..storage.store import Store, StoreError
+from ..storage.superblock import ReplicaPlacement
+from ..storage.types import FileId
+from ..storage.volume import dat_path, idx_path
+from ..util import glog, security
+from ..util.stats import Metrics
+from .master import _grpc_port
+
+_COPY_CHUNK = 1024 * 1024
+
+
+class VolumeServerError(RuntimeError):
+    pass
+
+
+class ClusterEcReader(EcVolumeReader):
+    """EcVolumeReader that falls back to peers for non-local shards.
+
+    Mirrors store_ec.go's readEcShardIntervals: local shard file first,
+    then ``VolumeEcShardRead`` against a server holding the shard; a
+    shard nobody holds returns None, which triggers interval
+    reconstruction upstream (recoverOneRemoteEcShardInterval).
+    """
+
+    def __init__(self, vs: "VolumeServer", volume_id: int,
+                 base: str | Path, scheme: EcScheme = DEFAULT_SCHEME):
+        super().__init__(base, scheme)
+        self._vs = vs
+        self._volume_id = volume_id
+
+    def _read_shard_range(self, shard_id: int, offset: int, size: int
+                          ) -> Optional[np.ndarray]:
+        local = super()._read_shard_range(shard_id, offset, size)
+        if local is not None:
+            return local
+        for url in self._vs.ec_shard_peers(self._volume_id, shard_id):
+            if url == self._vs.url:
+                continue
+            try:
+                data = self._vs.remote_shard_read(
+                    url, self._volume_id, shard_id, offset, size)
+            except Exception as e:  # peer down: try next / reconstruct
+                glog.v(1, "ec read from %s failed: %s", url, e)
+                continue
+            if data is not None and len(data) == size:
+                return np.frombuffer(data, dtype=np.uint8)
+        return None
+
+
+class VolumeServer:
+    def __init__(self, store: Store, ip: str = "127.0.0.1",
+                 port: int = 8080, master_url: str = "",
+                 public_url: str = "", data_center: str = "",
+                 rack: str = "", pulse_seconds: float = 5.0,
+                 secret: str = "", read_mode: str = "proxy"):
+        self.store = store
+        self.ip = ip
+        self.port = port
+        self.url = f"{ip}:{port}"
+        self.public_url = public_url or self.url
+        self.master_url = master_url
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.guard = security.Guard(secret)
+        self.metrics = Metrics(namespace="volume_server")
+        self.volume_size_limit = 30 * 1024 ** 3
+        self._channels: dict[str, object] = {}
+        self._grpc_server = None
+        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._lock = threading.RLock()
+
+    # ------------- lifecycle -------------
+
+    def start(self) -> "VolumeServer":
+        import grpc
+
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16))
+        self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
+            pb.VOLUME_SERVICE, pb.VOLUME_METHODS, _VolumeServicer(self)),))
+        bound = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{_grpc_port(self.port)}")
+        if bound == 0:
+            raise RuntimeError(
+                f"cannot bind volume grpc port {_grpc_port(self.port)}")
+        self._grpc_server.start()
+
+        handler = _make_http_handler(self)
+        self._http_server = ThreadingHTTPServer((self.ip, self.port), handler)
+        t = threading.Thread(target=self._http_server.serve_forever,
+                             daemon=True, name=f"volume-http-{self.port}")
+        t.start()
+        self._threads.append(t)
+
+        if self.master_url:
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                 name=f"volume-hb-{self.port}")
+            t.start()
+            self._threads.append(t)
+        glog.info("volume server started at %s (grpc %d)", self.url,
+                  _grpc_port(self.port))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+        self.store.close()
+
+    def __enter__(self) -> "VolumeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------- peers / master -------------
+
+    def _channel(self, url: str):
+        import grpc
+
+        with self._lock:
+            ch = self._channels.get(url)
+            if ch is None:
+                ip, http_port = url.rsplit(":", 1)
+                ch = grpc.insecure_channel(
+                    f"{ip}:{_grpc_port(int(http_port))}")
+                self._channels[url] = ch
+            return ch
+
+    def peer_stub(self, url: str) -> pb.Stub:
+        return pb.volume_stub(self._channel(url))
+
+    def master_stub(self) -> pb.Stub:
+        return pb.master_stub(self._channel(self.master_url))
+
+    def _heartbeat_snapshot(self) -> master_pb2.Heartbeat:
+        st = self.store.status()
+        hb = master_pb2.Heartbeat(
+            ip=self.ip, port=self.port, public_url=self.public_url,
+            max_volume_count=sum(l.max_volumes
+                                 for l in self.store.locations),
+            data_center=self.data_center, rack=self.rack,
+            has_no_volumes=not st["volumes"],
+            has_no_ec_shards=not st["ec_shards"])
+        max_key = 0
+        for v in st["volumes"]:
+            vol = self.store.volumes[(v["collection"], v["id"])]
+            max_key = max(max_key, vol.nm.max_key)
+            hb.volumes.add(
+                id=v["id"], collection=v["collection"], size=v["size"],
+                file_count=v["file_count"],
+                delete_count=v.get("deleted_count", 0),
+                read_only=v["read_only"],
+                replica_placement=ReplicaPlacement.parse(
+                    v["replica_placement"]).to_byte(),
+                version=v.get("version", 3))
+        for s in st["ec_shards"]:
+            hb.ec_shards.add(id=s["id"], collection=s["collection"],
+                             ec_index_bits=s["ec_index_bits"])
+        hb.max_file_key = max_key
+        return hb
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._run_heartbeat_stream()
+            except Exception as e:
+                if not self._stop.is_set():
+                    glog.v(1, "heartbeat stream to %s broke: %s",
+                           self.master_url, e)
+            self._stop.wait(self.pulse_seconds)
+
+    def _run_heartbeat_stream(self) -> None:
+        stub = self.master_stub()
+
+        def gen():
+            while not self._stop.is_set():
+                yield self._heartbeat_snapshot()
+                self._stop.wait(self.pulse_seconds)
+
+        for resp in stub.SendHeartbeat(gen()):
+            if resp.volume_size_limit:
+                self.volume_size_limit = resp.volume_size_limit
+            if self._stop.is_set():
+                return
+
+    def heartbeat_now(self) -> None:
+        """One immediate snapshot push (tests / post-admin-op nudge)."""
+        stub = self.master_stub()
+        for _ in stub.SendHeartbeat(iter([self._heartbeat_snapshot()])):
+            break
+
+    # ------------- EC shard location helpers -------------
+
+    def ec_shard_peers(self, volume_id: int, shard_id: int) -> list[str]:
+        """Servers holding one shard, from the master (cached ~1s)."""
+        if not self.master_url:
+            return []
+        now = time.time()
+        with self._lock:
+            cached = self._ec_loc_cache.get(volume_id)
+        if cached is None or now - cached[0] > 1.0:
+            resp = self.master_stub().LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=volume_id))
+            table = {e.shard_id: [l.url for l in e.locations]
+                     for e in resp.shard_id_locations}
+            with self._lock:
+                self._ec_loc_cache[volume_id] = (now, table)
+            cached = (now, table)
+        return cached[1].get(shard_id, [])
+
+    def remote_shard_read(self, url: str, volume_id: int, shard_id: int,
+                          offset: int, size: int) -> bytes:
+        out = bytearray()
+        for resp in self.peer_stub(url).VolumeEcShardRead(
+                volume_server_pb2.VolumeEcShardReadRequest(
+                    volume_id=volume_id, shard_id=shard_id,
+                    offset=offset, size=size)):
+            out.extend(resp.data)
+        return bytes(out)
+
+    # ------------- data plane -------------
+
+    def read_bytes(self, volume_id: int, fid: FileId,
+                   collection: str = "") -> bytes:
+        """GET path: normal volume first, then mounted EC shards."""
+        if self.store.has_volume(volume_id, collection):
+            n = self.store.read_needle(volume_id, fid.key, fid.cookie,
+                                       collection)
+            return n.data
+        mount = self.store.ec_mounts.get((collection, volume_id))
+        if mount is None and collection == "":
+            # Collection not known from the fid; match on vid alone.
+            for (c, vid), m in self.store.ec_mounts.items():
+                if vid == volume_id:
+                    mount = m
+                    break
+        if mount is None:
+            raise StoreError(f"volume {volume_id} not found")
+        reader = ClusterEcReader(self, volume_id, mount.base,
+                                 _scheme_from_vif(mount.base))
+        n = reader.read_needle(fid.key, fid.cookie)
+        self.metrics.counter("ec_intervals_repaired").inc(
+            reader.intervals_repaired)
+        return n.data
+
+    def write_needle_local(self, volume_id: int, n: Needle,
+                           collection: str = "") -> int:
+        return self.store.write_needle(volume_id, n, collection)
+
+    def replica_peers(self, volume_id: int, collection: str = ""
+                      ) -> list[str]:
+        if not self.master_url:
+            return []
+        resp = self.master_stub().LookupVolume(
+            master_pb2.LookupVolumeRequest(volume_ids=[str(volume_id)],
+                                           collection=collection))
+        for entry in resp.volume_id_locations:
+            return [l.url for l in entry.locations if l.url != self.url]
+        return []
+
+
+class _VolumeServicer:
+    """gRPC service impl; 1:1 with volume_grpc_*.go handlers."""
+
+    def __init__(self, vs: VolumeServer):
+        self.vs = vs
+
+    # ---- volume admin ----
+
+    def AllocateVolume(self, request, context):
+        self.vs.store.create_volume(
+            request.volume_id, request.collection,
+            request.replication or "000", request.ttl)
+        return volume_server_pb2.AllocateVolumeResponse()
+
+    def VolumeDelete(self, request, context):
+        self.vs.store.delete_volume(request.volume_id, request.collection)
+        return volume_server_pb2.VolumeDeleteResponse()
+
+    def VolumeMarkReadonly(self, request, context):
+        self.vs.store.mark_readonly(request.volume_id, request.collection)
+        return volume_server_pb2.VolumeMarkReadonlyResponse()
+
+    def VolumeStatus(self, request, context):
+        resp = volume_server_pb2.VolumeStatusResponse()
+        store = self.vs.store
+        if store.has_volume(request.volume_id, request.collection):
+            v = store.get_volume(request.volume_id, request.collection)
+            resp.has_volume = True
+            resp.dat_size = v.dat_size
+            resp.file_count = v.nm.file_count
+            resp.read_only = store.is_readonly(request.volume_id,
+                                               request.collection)
+        m = store.ec_mounts.get((request.collection, request.volume_id))
+        if m:
+            resp.ec_shard_ids.extend(sorted(m.shard_ids))
+        return resp
+
+    # ---- file streaming ----
+
+    def CopyFile(self, request, context):
+        base = self._base_for(request.volume_id, request.collection,
+                              must_exist=False)
+        if base is None:
+            raise StoreError(
+                f"volume {request.volume_id} has no local files")
+        path = Path(str(base) + request.ext)
+        if not path.exists():
+            if request.ignore_source_file_not_found:
+                return
+            raise StoreError(f"{path} does not exist")
+        stop = request.stop_offset or path.stat().st_size
+        with open(path, "rb") as f:
+            sent = 0
+            while sent < stop:
+                chunk = f.read(min(_COPY_CHUNK, stop - sent))
+                if not chunk:
+                    break
+                sent += len(chunk)
+                yield volume_server_pb2.CopyFileResponse(
+                    file_content=chunk)
+
+    def _base_for(self, volume_id: int, collection: str,
+                  must_exist: bool = True):
+        store = self.vs.store
+        if store.has_volume(volume_id, collection):
+            return store.get_volume(volume_id, collection).base
+        base = store.ec_base(volume_id, collection)
+        if base is None and must_exist:
+            raise StoreError(f"volume {volume_id} not found")
+        return base
+
+    # ---- EC family ----
+
+    def _scheme(self, data_shards: int, parity_shards: int) -> EcScheme:
+        if data_shards and parity_shards:
+            return EcScheme(data_shards, parity_shards)
+        return DEFAULT_SCHEME
+
+    def VolumeEcShardsGenerate(self, request, context):
+        """The §3.1 hot path: stripe + TPU encode + shard files."""
+        vs = self.vs
+        vol = vs.store.get_volume(request.volume_id, request.collection)
+        scheme = self._scheme(request.data_shards, request.parity_shards)
+        vol.sync()
+        encode_mod.encode_volume(vol.base, scheme)
+        return volume_server_pb2.VolumeEcShardsGenerateResponse()
+
+    def VolumeEcShardsRebuild(self, request, context):
+        """§3.5: pull sibling shards from peers, reconstruct only the
+        shards missing cluster-wide, drop the temporary copies."""
+        vs = self.vs
+        base = vs.store.ec_base(request.volume_id, request.collection)
+        if base is None:
+            raise StoreError(
+                f"no local ec files for volume {request.volume_id}")
+        scheme = _scheme_from_vif(base)
+        total = scheme.total_shards
+        local = set(ec_files.present_shards(base, total))
+        # Cluster-wide view: a shard is missing only if neither we nor
+        # any peer holds it.
+        missing = [sid for sid in range(total)
+                   if sid not in local
+                   and not vs.ec_shard_peers(request.volume_id, sid)]
+        resp = volume_server_pb2.VolumeEcShardsRebuildResponse()
+        if not missing:
+            return resp
+        # Fetch remote siblings until k survivors are on local disk.
+        fetched: list = []
+        for sid in range(total):
+            if len(local) >= scheme.data_shards:
+                break
+            if sid in local:
+                continue
+            for url in vs.ec_shard_peers(request.volume_id, sid):
+                if url == vs.url:
+                    continue
+                try:
+                    dest = ec_files.shard_path(base, sid)
+                    _copy_remote_file(
+                        vs, url, request.volume_id,
+                        request.collection, ec_files.shard_ext(sid), dest)
+                    local.add(sid)
+                    fetched.append(dest)
+                    break
+                except Exception as e:
+                    glog.v(1, "shard %d copy from %s failed: %s",
+                           sid, url, e)
+        try:
+            rebuilt = rebuild_mod.rebuild_ec_files(base, scheme,
+                                                   wanted=missing)
+        finally:
+            for p in fetched:
+                if p.exists():
+                    p.unlink()
+        vs.store.mount_ec_shards(request.volume_id, rebuilt,
+                                 request.collection)
+        resp.rebuilt_shard_ids.extend(rebuilt)
+        return resp
+
+    def VolumeEcShardsCopy(self, request, context):
+        """Pull shards (and index files) from source_data_node to here."""
+        vs = self.vs
+        loc = vs.store._pick_location()
+        from ..storage.store import volume_base_name
+
+        base = loc.directory / volume_base_name(request.volume_id,
+                                                request.collection)
+        src = request.source_data_node
+        for sid in request.shard_ids:
+            _copy_remote_file(vs, src, request.volume_id,
+                              request.collection, ec_files.shard_ext(sid),
+                              ec_files.shard_path(base, sid))
+        if request.copy_ecx_file:
+            _copy_remote_file(vs, src, request.volume_id,
+                              request.collection, ".ecx",
+                              ec_files.ecx_path(base))
+        if request.copy_ecj_file:
+            # .ecj may legitimately not exist (no post-seal deletes yet).
+            _copy_remote_file(vs, src, request.volume_id,
+                              request.collection, ".ecj",
+                              ec_files.ecj_path(base),
+                              ignore_missing=True)
+        if request.copy_vif_file:
+            _copy_remote_file(vs, src, request.volume_id,
+                              request.collection, ".vif",
+                              ec_files.vif_path(base))
+        return volume_server_pb2.VolumeEcShardsCopyResponse()
+
+    def VolumeEcShardsDelete(self, request, context):
+        base = self.vs.store.ec_base(request.volume_id, request.collection)
+        if base is not None:
+            for sid in request.shard_ids:
+                p = ec_files.shard_path(base, sid)
+                if p.exists() or p.is_symlink():
+                    p.unlink()
+        self.vs.store.unmount_ec_shards(
+            request.volume_id, list(request.shard_ids),
+            request.collection)
+        return volume_server_pb2.VolumeEcShardsDeleteResponse()
+
+    def VolumeEcShardsMount(self, request, context):
+        self.vs.store.mount_ec_shards(
+            request.volume_id, list(request.shard_ids),
+            request.collection)
+        return volume_server_pb2.VolumeEcShardsMountResponse()
+
+    def VolumeEcShardsUnmount(self, request, context):
+        self.vs.store.unmount_ec_shards(
+            request.volume_id, list(request.shard_ids))
+        return volume_server_pb2.VolumeEcShardsUnmountResponse()
+
+    def VolumeEcShardRead(self, request, context):
+        base = self.vs.store.ec_base(request.volume_id)
+        if base is None:
+            for (c, vid), m in self.vs.store.ec_mounts.items():
+                if vid == request.volume_id:
+                    base = m.base
+                    break
+        if base is None:
+            raise StoreError(
+                f"no shards for volume {request.volume_id} here")
+        path = ec_files.shard_path(base, request.shard_id)
+        if not path.exists():
+            raise StoreError(f"shard {request.shard_id} not here")
+        remaining = request.size
+        with open(path, "rb") as f:
+            f.seek(request.offset)
+            while remaining > 0:
+                chunk = f.read(min(_COPY_CHUNK, remaining))
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+                yield volume_server_pb2.VolumeEcShardReadResponse(
+                    data=chunk)
+
+    def VolumeEcShardsToVolume(self, request, context):
+        """ec.decode's server half: shards -> .dat/.idx again."""
+        base = self.vs.store.ec_base(request.volume_id, request.collection)
+        if base is None:
+            raise StoreError(
+                f"no local ec files for volume {request.volume_id}")
+        scheme = _scheme_from_vif(base)
+        decode_mod.decode_volume(base, scheme)
+        self.vs.store.unmount_ec_shards(
+            request.volume_id,
+            list(range(scheme.total_shards)), request.collection)
+        self.vs.store.load_existing()
+        return volume_server_pb2.VolumeEcShardsToVolumeResponse()
+
+    def VolumeEcBlobDelete(self, request, context):
+        base = self.vs.store.ec_base(request.volume_id, request.collection)
+        if base is None:
+            raise StoreError(
+                f"no local ec files for volume {request.volume_id}")
+        ec_files.ecj_append(base, request.file_key)
+        return volume_server_pb2.VolumeEcBlobDeleteResponse()
+
+
+def _scheme_from_vif(base) -> EcScheme:
+    """Geometry travels in the .vif (config-4 parametrization)."""
+    try:
+        vi = ec_files.VolumeInfo.load(base)
+        if vi.data_shards and vi.parity_shards:
+            return EcScheme(vi.data_shards, vi.parity_shards)
+    except Exception:
+        pass
+    return DEFAULT_SCHEME
+
+
+def _copy_remote_file(vs: VolumeServer, src_url: str, volume_id: int,
+                      collection: str, ext: str, dest: Path,
+                      ignore_missing: bool = False) -> None:
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_suffix(dest.suffix + ".part")
+    got_any = False
+    try:
+        with open(tmp, "wb") as f:
+            for resp in vs.peer_stub(src_url).CopyFile(
+                    volume_server_pb2.CopyFileRequest(
+                        volume_id=volume_id, collection=collection,
+                        ext=ext,
+                        ignore_source_file_not_found=ignore_missing)):
+                f.write(resp.file_content)
+                got_any = True
+    except Exception:
+        tmp.unlink(missing_ok=True)
+        raise
+    if ignore_missing and not got_any and tmp.stat().st_size == 0:
+        tmp.unlink()
+        return
+    tmp.replace(dest)
+
+
+def _make_http_handler(vs: VolumeServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, "volume http: " + fmt, *args)
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/octet-stream") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, obj, code: int = 200) -> None:
+            self._send(code, json.dumps(obj).encode(), "application/json")
+
+        def _parse_fid(self) -> tuple[int, FileId, dict]:
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            fid = FileId.parse(u.path.lstrip("/"))
+            return fid.volume_id, fid, q
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            if u.path == "/status":
+                self._json({"Version": "seaweedfs-tpu",
+                            **vs.store.status()})
+                return
+            if u.path == "/metrics":
+                self._send(200, vs.metrics.render().encode(),
+                           "text/plain")
+                return
+            t0 = time.perf_counter()
+            try:
+                vid, fid, q = self._parse_fid()
+                data = vs.read_bytes(vid, fid, q.get("collection", ""))
+                self._send(200, data)
+                vs.metrics.counter("read_requests", code="200").inc()
+            except (KeyError, StoreError) as e:
+                vs.metrics.counter("read_requests", code="404").inc()
+                self._json({"error": str(e)}, 404)
+            except Exception as e:
+                vs.metrics.counter("read_requests", code="500").inc()
+                self._json({"error": str(e)}, 500)
+            finally:
+                vs.metrics.histogram("read_seconds").observe(
+                    time.perf_counter() - t0)
+
+        def do_HEAD(self):
+            try:
+                vid, fid, q = self._parse_fid()
+                data = vs.read_bytes(vid, fid, q.get("collection", ""))
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+            except Exception:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        def do_POST(self):
+            t0 = time.perf_counter()
+            try:
+                vid, fid, q = self._parse_fid()
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                jwt = (self.headers.get("Authorization", "")
+                       .removeprefix("BEARER ").strip()
+                       or q.get("jwt", ""))
+                if not vs.guard.verify(jwt, str(fid)):
+                    self._json({"error": "unauthorized"}, 401)
+                    return
+                n = Needle(id=fid.key, cookie=fid.cookie, data=body)
+                vs.write_needle_local(vid, n, q.get("collection", ""))
+                if q.get("type") != "replicate":
+                    for peer in vs.replica_peers(vid,
+                                                 q.get("collection", "")):
+                        _replicate_http(peer, str(fid), body, jwt,
+                                        q.get("collection", ""))
+                self._json({"name": q.get("name", ""), "size": len(body)},
+                           201)
+                vs.metrics.counter("write_requests", code="201").inc()
+            except StoreError as e:
+                vs.metrics.counter("write_requests", code="404").inc()
+                self._json({"error": str(e)}, 404)
+            except Exception as e:
+                vs.metrics.counter("write_requests", code="500").inc()
+                self._json({"error": str(e)}, 500)
+            finally:
+                vs.metrics.histogram("write_seconds").observe(
+                    time.perf_counter() - t0)
+
+        do_PUT = do_POST
+
+        def do_DELETE(self):
+            try:
+                vid, fid, q = self._parse_fid()
+                jwt = (self.headers.get("Authorization", "")
+                       .removeprefix("BEARER ").strip()
+                       or q.get("jwt", ""))
+                if not vs.guard.verify(jwt, str(fid)):
+                    self._json({"error": "unauthorized"}, 401)
+                    return
+                ok = vs.store.delete_needle(vid, fid.key,
+                                            q.get("collection", ""))
+                if q.get("type") != "replicate":
+                    for peer in vs.replica_peers(vid,
+                                                 q.get("collection", "")):
+                        _replicate_http(peer, str(fid), None, jwt,
+                                        q.get("collection", ""))
+                self._json({"size": int(ok)})
+            except (KeyError, StoreError) as e:
+                self._json({"error": str(e)}, 404)
+            except Exception as e:
+                self._json({"error": str(e)}, 500)
+
+    return Handler
+
+
+def _replicate_http(peer_url: str, fid: str, body: Optional[bytes],
+                    jwt: str = "", collection: str = "") -> None:
+    """Fan a write/delete out to one replica (?type=replicate stops the
+    fan-out from cascading; topology/store_replicate.go)."""
+    import urllib.request
+
+    url = f"http://{peer_url}/{fid}?type=replicate"
+    if collection:
+        url += f"&collection={collection}"
+    if body is None:
+        req = urllib.request.Request(url, method="DELETE")
+    else:
+        req = urllib.request.Request(url, data=body, method="POST")
+    if jwt:
+        req.add_header("Authorization", f"BEARER {jwt}")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        resp.read()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """``python -m seaweedfs_tpu volume`` entry (weed/command/volume.go)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="volume")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-dir", action="append", required=True)
+    p.add_argument("-max", type=int, default=8)
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-publicUrl", default="")
+    p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-config", default="",
+                   help="security.toml for the shared JWT signing key")
+    args = p.parse_args(argv)
+    from ..util import config as config_mod
+    conf = config_mod.load(args.config) if args.config else {}
+    secret = config_mod.lookup(conf, "jwt.signing.key", "")
+    store = Store(args.dir, max_volumes=args.max)
+    store.load_existing()
+    vs = VolumeServer(store, ip=args.ip, port=args.port,
+                      master_url=args.mserver, public_url=args.publicUrl,
+                      data_center=args.dataCenter, rack=args.rack,
+                      pulse_seconds=args.pulseSeconds, secret=secret)
+    vs.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        vs.stop()
+    return 0

@@ -1,0 +1,85 @@
+"""Master client with a vid -> locations cache.
+
+Mirrors weed/wdclient (SURVEY.md §2 "Master client"): clients and the
+filer keep a cached volume-id -> server-locations map, refreshed through
+the master's LookupVolume, so repeated reads don't hit the master.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .. import pb
+from ..pb import master_pb2
+from .master import _grpc_port
+
+
+class MasterClient:
+    def __init__(self, master_url: str, cache_seconds: float = 10.0):
+        self.master_url = master_url
+        self.cache_seconds = cache_seconds
+        self._lock = threading.Lock()
+        self._vid_map: dict[int, tuple[float, list[dict]]] = {}
+        self._channel = None
+
+    def _stub(self) -> pb.Stub:
+        import grpc
+
+        with self._lock:
+            if self._channel is None:
+                ip, http_port = self.master_url.rsplit(":", 1)
+                self._channel = grpc.insecure_channel(
+                    f"{ip}:{_grpc_port(int(http_port))}")
+            return pb.master_stub(self._channel)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+
+    def lookup(self, volume_id: int, collection: str = "") -> list[dict]:
+        """[{'url', 'publicUrl'}] for a volume; cached."""
+        now = time.time()
+        with self._lock:
+            hit = self._vid_map.get(volume_id)
+            if hit and now - hit[0] < self.cache_seconds:
+                return hit[1]
+        resp = self._stub().LookupVolume(
+            master_pb2.LookupVolumeRequest(volume_ids=[str(volume_id)],
+                                           collection=collection))
+        locs: list[dict] = []
+        for entry in resp.volume_id_locations:
+            if entry.error:
+                raise KeyError(entry.error)
+            locs = [{"url": l.url, "publicUrl": l.public_url or l.url}
+                    for l in entry.locations]
+        with self._lock:
+            self._vid_map[volume_id] = (now, locs)
+        return locs
+
+    def lookup_ec(self, volume_id: int) -> dict[int, list[str]]:
+        resp = self._stub().LookupEcVolume(
+            master_pb2.LookupEcVolumeRequest(volume_id=volume_id))
+        return {e.shard_id: [l.url for l in e.locations]
+                for e in resp.shard_id_locations}
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl: str = "") -> dict:
+        resp = self._stub().Assign(master_pb2.AssignRequest(
+            count=count, collection=collection, replication=replication,
+            ttl=ttl))
+        if resp.error:
+            raise RuntimeError(resp.error)
+        return {"fid": resp.fid, "url": resp.url,
+                "publicUrl": resp.public_url, "count": resp.count,
+                "auth": resp.auth}
+
+    def invalidate(self, volume_id: Optional[int] = None) -> None:
+        with self._lock:
+            if volume_id is None:
+                self._vid_map.clear()
+            else:
+                self._vid_map.pop(volume_id, None)

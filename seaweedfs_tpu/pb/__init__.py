@@ -1,0 +1,149 @@
+"""Protobuf contracts + minimal gRPC stub plumbing.
+
+The reference keeps all cross-process contracts in weed/pb/ (SURVEY.md §2
+"Protos"); this package mirrors that with master.proto and
+volume_server.proto subsets, their protoc-generated ``*_pb2`` modules, and
+— because grpc_tools is not available in this environment — a small
+declarative layer that builds grpc client stubs and server registrations
+straight from the pb2 message classes (what ``*_pb2_grpc.py`` would have
+contained, minus the codegen).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from . import master_pb2, volume_server_pb2  # noqa: F401
+
+UNARY = "unary"
+SERVER_STREAM = "server_stream"
+BIDI_STREAM = "bidi_stream"
+
+
+@dataclass(frozen=True)
+class Method:
+    name: str
+    request_cls: type
+    response_cls: type
+    kind: str = UNARY
+
+
+#: /master_pb.Seaweed/... method table (matches master.proto service).
+MASTER_SERVICE = "master_pb.Seaweed"
+MASTER_METHODS = [
+    Method("SendHeartbeat", master_pb2.Heartbeat,
+           master_pb2.HeartbeatResponse, BIDI_STREAM),
+    Method("Assign", master_pb2.AssignRequest, master_pb2.AssignResponse),
+    Method("LookupVolume", master_pb2.LookupVolumeRequest,
+           master_pb2.LookupVolumeResponse),
+    Method("LookupEcVolume", master_pb2.LookupEcVolumeRequest,
+           master_pb2.LookupEcVolumeResponse),
+    Method("VolumeList", master_pb2.VolumeListRequest,
+           master_pb2.VolumeListResponse),
+    Method("GetMasterConfiguration",
+           master_pb2.GetMasterConfigurationRequest,
+           master_pb2.GetMasterConfigurationResponse),
+]
+
+#: /volume_server_pb.VolumeServer/... method table.
+VOLUME_SERVICE = "volume_server_pb.VolumeServer"
+VOLUME_METHODS = [
+    Method("AllocateVolume", volume_server_pb2.AllocateVolumeRequest,
+           volume_server_pb2.AllocateVolumeResponse),
+    Method("VolumeDelete", volume_server_pb2.VolumeDeleteRequest,
+           volume_server_pb2.VolumeDeleteResponse),
+    Method("VolumeMarkReadonly", volume_server_pb2.VolumeMarkReadonlyRequest,
+           volume_server_pb2.VolumeMarkReadonlyResponse),
+    Method("VolumeStatus", volume_server_pb2.VolumeStatusRequest,
+           volume_server_pb2.VolumeStatusResponse),
+    Method("CopyFile", volume_server_pb2.CopyFileRequest,
+           volume_server_pb2.CopyFileResponse, SERVER_STREAM),
+    Method("VolumeEcShardsGenerate",
+           volume_server_pb2.VolumeEcShardsGenerateRequest,
+           volume_server_pb2.VolumeEcShardsGenerateResponse),
+    Method("VolumeEcShardsRebuild",
+           volume_server_pb2.VolumeEcShardsRebuildRequest,
+           volume_server_pb2.VolumeEcShardsRebuildResponse),
+    Method("VolumeEcShardsCopy",
+           volume_server_pb2.VolumeEcShardsCopyRequest,
+           volume_server_pb2.VolumeEcShardsCopyResponse),
+    Method("VolumeEcShardsDelete",
+           volume_server_pb2.VolumeEcShardsDeleteRequest,
+           volume_server_pb2.VolumeEcShardsDeleteResponse),
+    Method("VolumeEcShardsMount",
+           volume_server_pb2.VolumeEcShardsMountRequest,
+           volume_server_pb2.VolumeEcShardsMountResponse),
+    Method("VolumeEcShardsUnmount",
+           volume_server_pb2.VolumeEcShardsUnmountRequest,
+           volume_server_pb2.VolumeEcShardsUnmountResponse),
+    Method("VolumeEcShardRead",
+           volume_server_pb2.VolumeEcShardReadRequest,
+           volume_server_pb2.VolumeEcShardReadResponse, SERVER_STREAM),
+    Method("VolumeEcShardsToVolume",
+           volume_server_pb2.VolumeEcShardsToVolumeRequest,
+           volume_server_pb2.VolumeEcShardsToVolumeResponse),
+    Method("VolumeEcBlobDelete",
+           volume_server_pb2.VolumeEcBlobDeleteRequest,
+           volume_server_pb2.VolumeEcBlobDeleteResponse),
+]
+
+
+def generic_handler(service_name: str, methods: list[Method],
+                    servicer) -> "grpc.GenericRpcHandler":
+    """Build the server-side dispatch table for one service.
+
+    ``servicer`` provides one method per Method.name; unary handlers take
+    (request, context), streaming handlers follow grpc's usual shapes.
+    """
+    import grpc
+
+    handlers: dict[str, object] = {}
+    for m in methods:
+        fn: Callable = getattr(servicer, m.name)
+        if m.kind == UNARY:
+            handlers[m.name] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=m.request_cls.FromString,
+                response_serializer=m.response_cls.SerializeToString)
+        elif m.kind == SERVER_STREAM:
+            handlers[m.name] = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=m.request_cls.FromString,
+                response_serializer=m.response_cls.SerializeToString)
+        elif m.kind == BIDI_STREAM:
+            handlers[m.name] = grpc.stream_stream_rpc_method_handler(
+                fn, request_deserializer=m.request_cls.FromString,
+                response_serializer=m.response_cls.SerializeToString)
+        else:  # pragma: no cover - table is static
+            raise ValueError(m.kind)
+    return grpc.method_handlers_generic_handler(service_name, handlers)
+
+
+class Stub:
+    """Client stub: one callable attribute per service method."""
+
+    def __init__(self, channel, service_name: str, methods: list[Method]):
+        for m in methods:
+            path = f"/{service_name}/{m.name}"
+            if m.kind == UNARY:
+                call = channel.unary_unary(
+                    path, request_serializer=m.request_cls.SerializeToString,
+                    response_deserializer=m.response_cls.FromString)
+            elif m.kind == SERVER_STREAM:
+                call = channel.unary_stream(
+                    path, request_serializer=m.request_cls.SerializeToString,
+                    response_deserializer=m.response_cls.FromString)
+            elif m.kind == BIDI_STREAM:
+                call = channel.stream_stream(
+                    path, request_serializer=m.request_cls.SerializeToString,
+                    response_deserializer=m.response_cls.FromString)
+            else:  # pragma: no cover
+                raise ValueError(m.kind)
+            setattr(self, m.name, call)
+
+
+def master_stub(channel) -> Stub:
+    return Stub(channel, MASTER_SERVICE, MASTER_METHODS)
+
+
+def volume_stub(channel) -> Stub:
+    return Stub(channel, VOLUME_SERVICE, VOLUME_METHODS)

@@ -77,7 +77,9 @@ def encode_volume(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     dat_size = write_ec_files(base, scheme, max_batch_bytes)
     write_ecx_file(base)
     vi = ec_files.VolumeInfo(version=version, replication=replication,
-                             dat_file_size=dat_size)
+                             dat_file_size=dat_size,
+                             data_shards=scheme.data_shards,
+                             parity_shards=scheme.parity_shards)
     vi.save(base)
     if remove_source:
         os.remove(volume_mod.dat_path(base))

@@ -79,6 +79,10 @@ class VolumeInfo:
     replication: str = ""
     ttl: str = ""
     dat_file_size: int = 0  # true .dat size (pre-padding), for decode
+    # RS geometry used at encode time (BASELINE config 4 parametrization);
+    # 0 means the RS(10,4) default.
+    data_shards: int = 0
+    parity_shards: int = 0
 
     def save(self, base: str | Path) -> None:
         doc = {"version": self.version}
@@ -88,6 +92,10 @@ class VolumeInfo:
             doc["ttl"] = self.ttl
         if self.dat_file_size:
             doc["datFileSize"] = self.dat_file_size
+        if self.data_shards:
+            doc["dataShards"] = self.data_shards
+        if self.parity_shards:
+            doc["parityShards"] = self.parity_shards
         vif_path(base).write_text(json.dumps(doc))
 
     @classmethod
@@ -99,7 +107,9 @@ class VolumeInfo:
         return cls(version=int(doc.get("version", 3)),
                    replication=doc.get("replication", ""),
                    ttl=doc.get("ttl", ""),
-                   dat_file_size=int(doc.get("datFileSize", 0)))
+                   dat_file_size=int(doc.get("datFileSize", 0)),
+                   data_shards=int(doc.get("dataShards", 0)),
+                   parity_shards=int(doc.get("parityShards", 0)))
 
 
 # -- shard presence ---------------------------------------------------------

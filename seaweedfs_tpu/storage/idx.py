@@ -70,6 +70,7 @@ class CompactMap:
         self.deleted_count = 0
         self.deleted_bytes = 0
         self.max_offset_units = 0
+        self.max_key = 0  # heartbeat max_file_key, maintained O(1)
 
     def set(self, key: int, offset_units: int, size: int) -> None:
         old = self._m.get(key)
@@ -79,6 +80,7 @@ class CompactMap:
         self._m[key] = IndexEntry(key, offset_units, size)
         self.file_count += 1
         self.max_offset_units = max(self.max_offset_units, offset_units)
+        self.max_key = max(self.max_key, key)
 
     def delete(self, key: int) -> bool:
         old = self._m.get(key)

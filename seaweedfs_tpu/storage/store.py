@@ -108,6 +108,7 @@ class Store:
         self.locations = [DiskLocation(d, max_volumes) for d in locations]
         self.volumes: dict[tuple[str, int], Volume] = {}
         self.ec_mounts: dict[tuple[str, int], EcVolumeMount] = {}
+        self.readonly: set[tuple[str, int]] = set()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -168,12 +169,22 @@ class Store:
     def has_volume(self, volume_id: int, collection: str = "") -> bool:
         return (collection, volume_id) in self.volumes
 
+    def mark_readonly(self, volume_id: int, collection: str = "") -> None:
+        """VolumeMarkReadonly: freeze writes ahead of ec.encode
+        (volume server admin gRPC; SURVEY.md §3.1)."""
+        self.get_volume(volume_id, collection)  # must exist
+        self.readonly.add((collection, volume_id))
+
+    def is_readonly(self, volume_id: int, collection: str = "") -> bool:
+        return (collection, volume_id) in self.readonly
+
     def delete_volume(self, volume_id: int, collection: str = "") -> None:
         """Drop the .dat/.idx (ec.encode's final step deletes the source
         volume this way)."""
         vol = self.get_volume(volume_id, collection)
         vol.close()
         del self.volumes[(collection, volume_id)]
+        self.readonly.discard((collection, volume_id))
         for p in (dat_path(vol.base), idx_path(vol.base)):
             if p.exists():
                 p.unlink()
@@ -182,6 +193,8 @@ class Store:
 
     def write_needle(self, volume_id: int, n: Needle,
                      collection: str = "") -> int:
+        if self.is_readonly(volume_id, collection):
+            raise StoreError(f"volume {volume_id} is read-only")
         return self.get_volume(volume_id, collection).write_needle(n)
 
     def read_needle(self, volume_id: int, key: int,
@@ -305,7 +318,7 @@ class Store:
                 "id": vid, "collection": col,
                 "size": v.dat_size, "file_count": v.nm.file_count,
                 "deleted_count": v.nm.deleted_count,
-                "read_only": False,
+                "read_only": (col, vid) in self.readonly,
                 "replica_placement": str(v.super_block.replica_placement),
                 "version": v.super_block.version,
             })

@@ -1,0 +1,7 @@
+"""Cross-cutting utilities: leveled logging, JWT auth, metrics, config.
+
+Mirrors the reference's weed/util + weed/security + weed/stats cluster
+(SURVEY.md §2 "Security", "Stats", "Util"): glog-style verbosity-leveled
+logging, HMAC-signed write tokens, Prometheus-text metrics, and a
+flags > TOML > defaults configuration loader.
+"""

@@ -1,0 +1,56 @@
+"""Configuration loading: flags > TOML > defaults.
+
+Mirrors weed/util's viper-loaded TOML (SURVEY.md §5 "Config/flag
+system"): each command's argparse flags are the primary surface; a TOML
+file (``security.toml``-style sections) fills in cross-cutting settings;
+hard defaults sit underneath. ``scaffold()`` prints a commented template
+like ``weed scaffold``.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from pathlib import Path
+
+SCAFFOLDS = {
+    "security": """\
+# security.toml — JWT signing for write requests (weed scaffold analog).
+[jwt.signing]
+key = ""            # non-empty enables write JWT verification
+expires_after_seconds = 10
+""",
+    "master": """\
+# master.toml
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+copy_other = 1
+""",
+}
+
+
+def load(path: str | Path) -> dict:
+    """Parse one TOML file into nested dicts; missing file -> {}."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    with open(p, "rb") as f:
+        return tomllib.load(f)
+
+
+def lookup(conf: dict, dotted: str, default=None):
+    """conf['a']['b']['c'] via 'a.b.c', with default."""
+    cur = conf
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def scaffold(name: str) -> str:
+    if name not in SCAFFOLDS:
+        raise KeyError(f"no scaffold named {name!r}; "
+                       f"have {sorted(SCAFFOLDS)}")
+    return SCAFFOLDS[name]

@@ -1,0 +1,72 @@
+"""Write-path auth: HMAC-signed JWTs + cookies.
+
+Mirrors weed/security (SURVEY.md §2 "Security"): when a signing key is
+configured, the master attaches a short-lived token to each Assign
+response (``GenJwt``) and volume servers verify it on writes/deletes
+(``Guard``). Tokens are standard JWS compact HS256 — header.payload.sig
+with base64url parts — built on hashlib/hmac so no external jwt
+dependency is needed. An empty key disables enforcement, matching the
+reference's default.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets
+import time
+
+
+def new_cookie() -> int:
+    """Random 32-bit needle cookie (needle/file_id semantics)."""
+    return secrets.randbits(32)
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class Guard:
+    """Issues and checks HS256 tokens scoped to one file id."""
+
+    def __init__(self, key: str = "", expires_seconds: int = 10):
+        self.key = key.encode() if key else b""
+        self.expires_seconds = expires_seconds
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.key)
+
+    def sign(self, fid: str) -> str:
+        if not self.enabled:
+            return ""
+        header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        payload = _b64(json.dumps({
+            "fid": fid,
+            "exp": int(time.time()) + self.expires_seconds}).encode())
+        signing_input = f"{header}.{payload}".encode()
+        sig = _b64(hmac.new(self.key, signing_input, hashlib.sha256)
+                   .digest())
+        return f"{header}.{payload}.{sig}"
+
+    def verify(self, token: str, fid: str) -> bool:
+        """True iff the token is valid for ``fid`` (or auth is off)."""
+        if not self.enabled:
+            return True
+        try:
+            header, payload, sig = token.split(".")
+            signing_input = f"{header}.{payload}".encode()
+            want = hmac.new(self.key, signing_input, hashlib.sha256).digest()
+            if not hmac.compare_digest(want, _unb64(sig)):
+                return False
+            claims = json.loads(_unb64(payload))
+            return (claims.get("fid") == fid
+                    and claims.get("exp", 0) >= time.time())
+        except (ValueError, KeyError, json.JSONDecodeError):
+            return False

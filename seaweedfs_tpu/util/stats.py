@@ -1,0 +1,119 @@
+"""Prometheus-text metrics registry.
+
+Mirrors weed/stats (SURVEY.md §2 "Stats", §5 observability): counters,
+gauges, and latency histograms addressable by name+labels, rendered in
+Prometheus exposition format at each server's ``/metrics`` endpoint.
+Self-contained (no prometheus client dependency).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Iterable
+
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def add(self, amount: float) -> None:
+        with self._lock:
+            self.value += float(amount)
+
+
+class Histogram:
+    def __init__(self, buckets: Iterable[float] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.counts[bisect_right(self.buckets, value)] += 1
+            self.total += value
+            self.n += 1
+
+
+class Metrics:
+    """One registry per server process."""
+
+    def __init__(self, namespace: str = "seaweedfs_tpu"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple[str, tuple, str], object] = {}
+
+    def _get(self, kind: str, name: str, labels: dict[str, str],
+             factory):
+        key = (name, tuple(sorted((labels or {}).items())), kind)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = factory()
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels, Gauge)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get("histogram", name, labels, Histogram)
+
+    def render(self) -> str:
+        """Prometheus exposition text."""
+        lines: list[str] = []
+        with self._lock:
+            items = sorted(self._metrics.items(),
+                           key=lambda kv: (kv[0][0], kv[0][1]))
+        for (name, labels, kind), m in items:
+            full = f"{self.namespace}_{name}"
+            lab = _fmt_labels(dict(labels))
+            if kind == "counter":
+                lines.append(f"# TYPE {full} counter")
+                lines.append(f"{full}{lab} {m.value}")
+            elif kind == "gauge":
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full}{lab} {m.value}")
+            else:
+                lines.append(f"# TYPE {full} histogram")
+                cum = 0
+                for b, c in zip(m.buckets, m.counts):
+                    cum += c
+                    le = dict(labels); le["le"] = repr(b)
+                    lines.append(f"{full}_bucket{_fmt_labels(le)} {cum}")
+                le = dict(labels); le["le"] = "+Inf"
+                lines.append(
+                    f"{full}_bucket{_fmt_labels(le)} {m.n}")
+                lines.append(f"{full}_sum{lab} {m.total}")
+                lines.append(f"{full}_count{lab} {m.n}")
+        return "\n".join(lines) + "\n"

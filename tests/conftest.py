@@ -2,14 +2,24 @@
 
 Multi-chip hardware is not available in this environment, so sharding tests
 run against XLA:CPU with ``--xla_force_host_platform_device_count=8``
-(see the driver's ``dryrun_multichip`` contract). This must happen before
-jax is imported anywhere in the test process.
+(see the driver's ``dryrun_multichip`` contract).
+
+The interpreter may arrive with jax ALREADY imported (sitecustomize) and
+``JAX_PLATFORMS=axon`` latched from the environment, so setting env vars
+here is not enough — use ``jax.config.update`` before the first backend
+initialization, which still wins as long as no device backend has been
+created yet. ``XLA_FLAGS`` is read by the CPU client at backend creation,
+so mutating it here is likewise still effective.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,99 @@
+"""Topology tree, layouts, placement, and sequencer unit tests
+(weed/topology/volume_layout_test.go's strategy)."""
+
+import pytest
+
+from seaweedfs_tpu.cluster.sequence import MemorySequencer
+from seaweedfs_tpu.cluster.topology import (
+    Topology, TopologyError, VolumeInfo)
+
+
+def _hb(topo, url, dc="dc1", rack="r1", volumes=(), ec=(), max_vol=8):
+    return topo.register_heartbeat(
+        url, data_center=dc, rack=rack, max_volume_count=max_vol,
+        volumes=volumes, ec_shards=ec)
+
+
+def test_register_and_lookup():
+    t = Topology(seed=0)
+    _hb(t, "h1:8080", volumes=[VolumeInfo(id=1, size=10)])
+    _hb(t, "h2:8080", volumes=[VolumeInfo(id=1, size=10)])
+    nodes = t.lookup_volume(1)
+    assert sorted(n.url for n in nodes) == ["h1:8080", "h2:8080"]
+    assert t.lookup_volume(9) == []
+    assert t.max_volume_id == 1
+
+
+def test_pick_for_write_respects_replication_count():
+    t = Topology(seed=0)
+    # replica placement 001 needs 2 copies; only one node has it.
+    _hb(t, "h1:8080", volumes=[
+        VolumeInfo(id=1, replica_placement="001")])
+    with pytest.raises(TopologyError):
+        t.pick_for_write(replication="001")
+    _hb(t, "h2:8080", volumes=[
+        VolumeInfo(id=1, replica_placement="001")])
+    vid, nodes = t.pick_for_write(replication="001")
+    assert vid == 1 and len(nodes) == 2
+
+
+def test_pick_for_write_skips_readonly_and_full():
+    t = Topology(volume_size_limit=100, seed=0)
+    _hb(t, "h1:8080", volumes=[
+        VolumeInfo(id=1, read_only=True),
+        VolumeInfo(id=2, size=1000),      # over limit
+        VolumeInfo(id=3, size=10)])
+    vid, _ = t.pick_for_write()
+    assert vid == 3
+
+
+def test_grow_targets_rack_aware():
+    t = Topology(seed=0)
+    _hb(t, "h1:8080", dc="dc1", rack="r1")
+    _hb(t, "h2:8080", dc="dc1", rack="r1")
+    _hb(t, "h3:8080", dc="dc1", rack="r2")
+    # 010 = one replica on a different rack, same DC.
+    targets = t.pick_grow_targets("010")
+    assert len(targets) == 2
+    assert len({n.rack for n in targets}) == 2
+    # 001 = same rack: must pick the two r1 nodes.
+    targets = t.pick_grow_targets("001")
+    assert {n.rack for n in targets} == {targets[0].rack}
+    # 100 = different DC: impossible with one DC.
+    with pytest.raises(TopologyError):
+        t.pick_grow_targets("100")
+
+
+def test_ec_shard_locations_and_spread():
+    t = Topology(seed=0)
+    _hb(t, "h1:8080", ec=[("", 5, 0b0000000000111)])   # shards 0,1,2
+    _hb(t, "h2:8080", ec=[("", 5, 0b1100000000000)])   # shards 11,12
+    locs = t.lookup_ec_volume(5)
+    assert sorted(locs) == [0, 1, 2, 11, 12]
+    assert [n.url for n in locs[11]] == ["h2:8080"]
+    spread = t.pick_ec_spread(14)
+    assert len(spread) == 14
+    # Lookup via volume map is empty but EC answers in lookup path.
+    assert t.lookup_volume(5) == []
+
+
+def test_dead_node_reaping():
+    t = Topology(pulse_seconds=0.01, seed=0)
+    node = _hb(t, "h1:8080", volumes=[VolumeInfo(id=1)])
+    node.last_seen -= 10
+    dead = t.reap_dead_nodes()
+    assert dead == ["h1:8080"]
+    assert t.lookup_volume(1) == []
+
+
+def test_sequencer_monotonic_and_persistent(tmp_path):
+    p = tmp_path / "seq"
+    s = MemorySequencer(persist_path=p, checkpoint_every=10)
+    first = s.next_batch(5)
+    assert s.next_batch(1) == first + 5
+    s.set_max(100)
+    assert s.peek() == 101
+    # Restart must never reissue an id seen before.
+    s2 = MemorySequencer(persist_path=p, checkpoint_every=10)
+    assert s2.peek() > 101 - 10  # at least past last checkpoint window
+    assert s2.next_batch(1) >= s.peek() - 10

@@ -1,0 +1,75 @@
+"""util layer: JWT guard, metrics rendering, TOML config, glog."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import config, glog, security, stats
+
+
+def test_guard_disabled_accepts_everything():
+    g = security.Guard("")
+    assert not g.enabled
+    assert g.sign("3,0102") == ""
+    assert g.verify("", "3,0102")
+    assert g.verify("garbage", "3,0102")
+
+
+def test_guard_sign_verify_roundtrip():
+    g = security.Guard("topsecret")
+    tok = g.sign("3,0102deadbeef")
+    assert tok.count(".") == 2
+    assert g.verify(tok, "3,0102deadbeef")
+    assert not g.verify(tok, "3,9999deadbeef")   # wrong fid
+    assert not g.verify(tok + "x", "3,0102deadbeef")
+    assert not g.verify("", "3,0102deadbeef")
+    g2 = security.Guard("otherkey")
+    assert not g2.verify(tok, "3,0102deadbeef")  # wrong key
+
+
+def test_guard_expiry():
+    g = security.Guard("k", expires_seconds=-1)  # already expired
+    tok = g.sign("1,01")
+    assert not g.verify(tok, "1,01")
+
+
+def test_metrics_render_prometheus_text():
+    m = stats.Metrics(namespace="test")
+    m.counter("reqs", code="200").inc()
+    m.counter("reqs", code="200").inc()
+    m.counter("reqs", code="404").inc()
+    m.gauge("vols").set(7)
+    m.histogram("lat").observe(0.003)
+    text = m.render()
+    assert 'test_reqs{code="200"} 2.0' in text
+    assert 'test_reqs{code="404"} 1.0' in text
+    assert "test_vols 7.0" in text
+    assert "test_lat_count 1" in text
+    assert "# TYPE test_lat histogram" in text
+
+
+def test_config_load_and_lookup(tmp_path):
+    p = tmp_path / "security.toml"
+    p.write_text('[jwt.signing]\nkey = "abc"\n')
+    conf = config.load(p)
+    assert config.lookup(conf, "jwt.signing.key") == "abc"
+    assert config.lookup(conf, "jwt.missing", "dflt") == "dflt"
+    assert config.load(tmp_path / "nope.toml") == {}
+
+
+def test_config_scaffold():
+    text = config.scaffold("security")
+    assert "[jwt.signing]" in text
+    with pytest.raises(KeyError):
+        config.scaffold("bogus")
+
+
+def test_glog_verbosity(capsys):
+    old = glog.VERBOSITY
+    try:
+        glog.set_verbosity(0)
+        glog.v(1, "hidden %d", 1)
+        glog.set_verbosity(2)
+        glog.v(1, "shown %d", 2)
+    finally:
+        glog.set_verbosity(old)
