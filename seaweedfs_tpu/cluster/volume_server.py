@@ -231,6 +231,8 @@ class VolumeServer:
 
     def heartbeat_now(self) -> None:
         """One immediate snapshot push (tests / post-admin-op nudge)."""
+        if not self.master_url:
+            return
         stub = self.master_stub()
         for _ in stub.SendHeartbeat(iter([self._heartbeat_snapshot()])):
             break
@@ -321,6 +323,7 @@ class _VolumeServicer:
 
     def VolumeDelete(self, request, context):
         self.vs.store.delete_volume(request.volume_id, request.collection)
+        self.vs.heartbeat_now()
         return volume_server_pb2.VolumeDeleteResponse()
 
     def VolumeMarkReadonly(self, request, context):
@@ -345,6 +348,13 @@ class _VolumeServicer:
     # ---- file streaming ----
 
     def CopyFile(self, request, context):
+        store = self.vs.store
+        # Flush buffered appends so the streamed bytes are complete
+        # (the write path holds .dat/.idx open with userspace buffers).
+        if (request.ext in (".dat", ".idx")
+                and store.has_volume(request.volume_id,
+                                     request.collection)):
+            store.get_volume(request.volume_id, request.collection).sync()
         base = self._base_for(request.volume_id, request.collection,
                               must_exist=False)
         if base is None:
@@ -365,6 +375,38 @@ class _VolumeServicer:
                 sent += len(chunk)
                 yield volume_server_pb2.CopyFileResponse(
                     file_content=chunk)
+
+    def VolumeCopy(self, request, context):
+        """Pull a whole .dat/.idx pair from the source node and register
+        the volume locally (volume.balance / fix.replication's mover).
+
+        The .idx is copied BEFORE the .dat so a write that lands on the
+        source mid-copy can only leave the replica's .dat with unindexed
+        tail bytes (harmless), never an index entry pointing past the end
+        of the data file. Callers that delete the source afterwards
+        (volume.balance) must freeze it with VolumeMarkReadonly first.
+        """
+        vs = self.vs
+        if vs.store.has_volume(request.volume_id, request.collection):
+            raise StoreError(
+                f"volume {request.volume_id} already exists here")
+        base = _dest_base(vs, request.volume_id, request.collection)
+        src = request.source_data_node
+        try:
+            _copy_remote_file(vs, src, request.volume_id,
+                              request.collection, ".idx", idx_path(base))
+            _copy_remote_file(vs, src, request.volume_id,
+                              request.collection, ".dat", dat_path(base))
+        except Exception:
+            # No half-volume may survive: an orphan .dat would register
+            # as an empty volume on the next load_existing().
+            for p in (dat_path(base), idx_path(base)):
+                p.unlink(missing_ok=True)
+            raise
+        vs.store.load_existing()
+        vs.heartbeat_now()
+        return volume_server_pb2.VolumeCopyResponse(
+            last_append_at_ns=time.time_ns())
 
     def _base_for(self, volume_id: int, collection: str,
                   must_exist: bool = True):
@@ -447,11 +489,7 @@ class _VolumeServicer:
     def VolumeEcShardsCopy(self, request, context):
         """Pull shards (and index files) from source_data_node to here."""
         vs = self.vs
-        loc = vs.store._pick_location()
-        from ..storage.store import volume_base_name
-
-        base = loc.directory / volume_base_name(request.volume_id,
-                                                request.collection)
+        base = _dest_base(vs, request.volume_id, request.collection)
         src = request.source_data_node
         for sid in request.shard_ids:
             _copy_remote_file(vs, src, request.volume_id,
@@ -541,6 +579,14 @@ class _VolumeServicer:
                 f"no local ec files for volume {request.volume_id}")
         ec_files.ecj_append(base, request.file_key)
         return volume_server_pb2.VolumeEcBlobDeleteResponse()
+
+
+def _dest_base(vs: VolumeServer, volume_id: int, collection: str) -> Path:
+    """Destination base path for files pulled onto this server."""
+    from ..storage.store import volume_base_name
+
+    loc = vs.store._pick_location()
+    return loc.directory / volume_base_name(volume_id, collection)
 
 
 def _scheme_from_vif(base) -> EcScheme:

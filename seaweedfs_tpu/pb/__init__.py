@@ -59,6 +59,8 @@ VOLUME_METHODS = [
            volume_server_pb2.VolumeStatusResponse),
     Method("CopyFile", volume_server_pb2.CopyFileRequest,
            volume_server_pb2.CopyFileResponse, SERVER_STREAM),
+    Method("VolumeCopy", volume_server_pb2.VolumeCopyRequest,
+           volume_server_pb2.VolumeCopyResponse),
     Method("VolumeEcShardsGenerate",
            volume_server_pb2.VolumeEcShardsGenerateRequest,
            volume_server_pb2.VolumeEcShardsGenerateResponse),

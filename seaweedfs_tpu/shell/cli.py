@@ -1,10 +1,12 @@
 """Shell entry point: REPL or one-shot command execution.
 
-`weed shell` analog (weed/command/shell.go): interactive loop reading
-commands against the configured disk locations; ``-c`` runs one command
-and exits (useful for scripts and tests):
+`weed shell` analog (weed/command/shell.go): with ``-master`` the
+commands drive a live cluster over gRPC (the reference's only mode);
+with ``-dir`` they operate directly on local disk locations (an offline
+repair mode the reference covers with `weed fix`/`weed export`
+style commands). ``-c`` runs one command and exits:
 
-    python -m seaweedfs_tpu shell -dir /data/vol1 -dir /data/vol2
+    python -m seaweedfs_tpu shell -master 127.0.0.1:9333
     python -m seaweedfs_tpu shell -dir /data -c "ec.encode -volumeId 3"
 """
 
@@ -15,6 +17,7 @@ import sys
 
 from ..storage.store import Store
 from .commands import CommandEnv, ShellError, run_command
+from .cluster_commands import ClusterEnv, run_cluster_command
 
 
 def build_env(dirs: list[str], max_volumes: int = 8) -> CommandEnv:
@@ -23,36 +26,54 @@ def build_env(dirs: list[str], max_volumes: int = 8) -> CommandEnv:
     return CommandEnv(store=store)
 
 
+def _repl(run, env) -> int:
+    while True:
+        try:
+            line = input("> ")
+        except EOFError:
+            return 0
+        if line.strip() in ("exit", "quit"):
+            return 0
+        try:
+            run(env, line)
+        except ShellError as e:
+            print(f"error: {e}", file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="shell", allow_abbrev=False)
-    p.add_argument("-dir", action="append", required=True,
-                   help="disk location (repeatable)")
+    p.add_argument("-dir", action="append", default=None,
+                   help="local disk location (repeatable; offline mode)")
+    p.add_argument("-master", default=None,
+                   help="master ip:port (cluster mode)")
     p.add_argument("-maxVolumes", type=int, default=8)
     p.add_argument("-c", dest="oneshot", default=None,
                    help="run one command and exit")
     args = p.parse_args(argv)
-    env = build_env(args.dir, args.maxVolumes)
+    if bool(args.dir) == bool(args.master):
+        print("error: exactly one of -dir / -master is required",
+              file=sys.stderr)
+        return 2
+
+    if args.master:
+        env = ClusterEnv(master_url=args.master)
+        run = run_cluster_command
+        cleanup = env.close
+    else:
+        env = build_env(args.dir, args.maxVolumes)
+        run = run_command
+        cleanup = env.store.close
     try:
         if args.oneshot is not None:
             try:
-                run_command(env, args.oneshot)
+                run(env, args.oneshot)
             except ShellError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
             return 0
-        while True:
-            try:
-                line = input("> ")
-            except EOFError:
-                return 0
-            if line.strip() in ("exit", "quit"):
-                return 0
-            try:
-                run_command(env, line)
-            except ShellError as e:
-                print(f"error: {e}", file=sys.stderr)
+        return _repl(run, env)
     finally:
-        env.store.close()
+        cleanup()
 
 
 if __name__ == "__main__":

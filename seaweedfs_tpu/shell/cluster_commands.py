@@ -1,0 +1,475 @@
+"""Cluster-mode shell commands: choreography over master + volume gRPC.
+
+Mirrors weed/shell's cluster commands (SURVEY.md §2 "Shell", §3.1/§3.5):
+where the local-mode commands in commands.py operate on a Store's
+directories, these drive a live cluster the way the reference does —
+lookup state from the master, then sequence VolumeMarkReadonly /
+VolumeEcShardsGenerate / Copy / Mount / Delete rpcs across volume
+servers. Shares the registry protocol with commands.py: each command is
+``fn(env: ClusterEnv, argv)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import shlex
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..pb import master_pb2, volume_server_pb2
+from ..storage.ec_files import ShardBits
+from .commands import ShellError, _parser
+
+
+@dataclass
+class EcNode:
+    """One data node's view for EC planning (shell's ecNode struct)."""
+    url: str
+    data_center: str
+    rack: str
+    free_slots: int
+    shards: dict[int, list[int]]  # vid -> shard ids here
+
+    def shard_count(self) -> int:
+        return sum(len(s) for s in self.shards.values())
+
+
+@dataclass
+class ClusterEnv:
+    """Dial info + cached stubs for one cluster (CommandEnv in shell/)."""
+
+    master_url: str
+    out: io.TextIOBase = None  # type: ignore[assignment]
+    _channels: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.out is None:
+            import sys
+            self.out = sys.stdout
+
+    def println(self, *args) -> None:
+        print(*args, file=self.out)
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+
+    # -- stubs --
+
+    def _channel(self, url: str, grpc_offset: int = 10000):
+        import grpc
+
+        ch = self._channels.get(url)
+        if ch is None:
+            ip, port = url.rsplit(":", 1)
+            ch = grpc.insecure_channel(f"{ip}:{int(port) + grpc_offset}")
+            self._channels[url] = ch
+        return ch
+
+    def master(self):
+        from .. import pb
+        return pb.master_stub(self._channel(self.master_url))
+
+    def volume(self, url: str):
+        from .. import pb
+        return pb.volume_stub(self._channel(url))
+
+    # -- cluster state --
+
+    def volume_list(self) -> master_pb2.VolumeListResponse:
+        return self.master().VolumeList(master_pb2.VolumeListRequest())
+
+    def collect_ec_nodes(self) -> list[EcNode]:
+        resp = self.volume_list()
+        nodes = []
+        for dc in resp.topology_info.data_center_infos:
+            for rack in dc.rack_infos:
+                for dn in rack.data_node_infos:
+                    shards: dict[int, list[int]] = {}
+                    for s in dn.ec_shard_infos:
+                        shards[s.id] = ShardBits(s.ec_index_bits).ids()
+                    nodes.append(EcNode(
+                        url=dn.id, data_center=dc.id, rack=rack.id,
+                        free_slots=dn.free_volume_count, shards=shards))
+        return nodes
+
+    def volume_locations(self, vid: int) -> list[str]:
+        resp = self.master().LookupVolume(
+            master_pb2.LookupVolumeRequest(volume_ids=[str(vid)]))
+        for e in resp.volume_id_locations:
+            if e.error:
+                raise ShellError(e.error)
+            return [l.url for l in e.locations]
+        return []
+
+
+CLUSTER_COMMANDS: dict[str, Callable[[ClusterEnv, list[str]], None]] = {}
+
+
+def cluster_command(name: str):
+    def register(fn):
+        CLUSTER_COMMANDS[name] = fn
+        return fn
+    return register
+
+
+def _spread_targets(nodes: list[EcNode], total: int) -> list[EcNode]:
+    """Rack-aware round-robin over least-loaded nodes (the spread step of
+    command_ec_encode.go)."""
+    if not nodes:
+        raise ShellError("no data nodes in topology")
+    by_rack: dict[tuple[str, str], list[EcNode]] = {}
+    for n in sorted(nodes, key=lambda n: n.shard_count()):
+        by_rack.setdefault((n.data_center, n.rack), []).append(n)
+    racks = sorted(by_rack.values(),
+                   key=lambda ns: sum(n.shard_count() for n in ns))
+    out: list[EcNode] = []
+    i = 0
+    while len(out) < total:
+        rack = racks[i % len(racks)]
+        out.append(rack[(i // len(racks)) % len(rack)])
+        i += 1
+    return out
+
+
+@cluster_command("ec.encode")
+def cmd_ec_encode(env: ClusterEnv, argv: list[str]) -> None:
+    """Full §3.1 choreography: mark readonly -> generate on the owning
+    server -> spread shards rack-aware (copy+mount, delete moved) ->
+    delete the source volume."""
+    p = _parser("ec.encode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dataShards", type=int, default=0)
+    p.add_argument("-parityShards", type=int, default=0)
+    args = p.parse_args(argv)
+    vid, col = args.volumeId, args.collection
+
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise ShellError(f"volume {vid} not found")
+    source = locs[0]
+    src = env.volume(source)
+    src.VolumeMarkReadonly(volume_server_pb2.VolumeMarkReadonlyRequest(
+        volume_id=vid, collection=col))
+    src.VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection=col,
+            data_shards=args.dataShards,
+            parity_shards=args.parityShards))
+    total = ((args.dataShards + args.parityShards)
+             if args.dataShards and args.parityShards else 14)
+    src.VolumeEcShardsMount(volume_server_pb2.VolumeEcShardsMountRequest(
+        volume_id=vid, collection=col, shard_ids=list(range(total))))
+
+    targets = _spread_targets(env.collect_ec_nodes(), total)
+    per_target: dict[str, list[int]] = {}
+    for sid, node in enumerate(targets):
+        per_target.setdefault(node.url, []).append(sid)
+    for url, sids in per_target.items():
+        if url == source:
+            continue
+        tgt = env.volume(url)
+        tgt.VolumeEcShardsCopy(volume_server_pb2.VolumeEcShardsCopyRequest(
+            volume_id=vid, collection=col, shard_ids=sids,
+            copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+            source_data_node=source))
+        tgt.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=col, shard_ids=sids))
+        src.VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=col, shard_ids=sids))
+    # Every replica of the now-sealed volume is dropped (the EC copy is
+    # authoritative from here on).
+    for url in locs:
+        env.volume(url).VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid,
+                                                  collection=col))
+    env.println(f"ec.encode volume {vid}: {total} shards over "
+                f"{len(per_target)} servers")
+
+
+@cluster_command("ec.rebuild")
+def cmd_ec_rebuild(env: ClusterEnv, argv: list[str]) -> None:
+    """§3.5: for every EC volume with missing shards, pick a rebuilder
+    holding >=1 shard and run VolumeEcShardsRebuild there."""
+    p = _parser("ec.rebuild")
+    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    nodes = env.collect_ec_nodes()
+    # vid -> {shard ids present anywhere}
+    present: dict[int, set[int]] = {}
+    holders: dict[int, list[EcNode]] = {}
+    for n in nodes:
+        for vid, sids in n.shards.items():
+            present.setdefault(vid, set()).update(sids)
+            holders.setdefault(vid, []).append(n)
+    todo = [args.volumeId] if args.volumeId else sorted(present)
+    for vid in todo:
+        have = present.get(vid, set())
+        if not have:
+            env.println(f"ec.rebuild volume {vid}: no shards anywhere")
+            continue
+        total = 14 if max(have) < 14 else max(have) + 1
+        missing = sorted(set(range(total)) - have)
+        if not missing:
+            env.println(f"ec.rebuild volume {vid}: all shards present")
+            continue
+        rebuilder = max(holders[vid],
+                        key=lambda n: len(n.shards.get(vid, [])))
+        resp = env.volume(rebuilder.url).VolumeEcShardsRebuild(
+            volume_server_pb2.VolumeEcShardsRebuildRequest(
+                volume_id=vid, collection=args.collection))
+        env.println(f"ec.rebuild volume {vid}: rebuilt "
+                    f"{list(resp.rebuilt_shard_ids)} on {rebuilder.url}")
+
+
+@cluster_command("ec.decode")
+def cmd_ec_decode(env: ClusterEnv, argv: list[str]) -> None:
+    """Collect all shards onto the biggest holder, then
+    VolumeEcShardsToVolume turns them back into a normal volume
+    (command_ec_decode.go)."""
+    p = _parser("ec.decode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    vid, col = args.volumeId, args.collection
+    nodes = [n for n in env.collect_ec_nodes() if vid in n.shards]
+    if not nodes:
+        raise ShellError(f"no EC shards for volume {vid}")
+    collector = max(nodes, key=lambda n: len(n.shards.get(vid, [])))
+    have = set(collector.shards[vid])
+    cstub = env.volume(collector.url)
+    for n in nodes:
+        if n is collector:
+            continue
+        need = [s for s in n.shards[vid] if s not in have]
+        if not need:
+            continue
+        cstub.VolumeEcShardsCopy(
+            volume_server_pb2.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=col, shard_ids=need,
+                source_data_node=n.url))
+        have.update(need)
+    cstub.VolumeEcShardsToVolume(
+        volume_server_pb2.VolumeEcShardsToVolumeRequest(
+            volume_id=vid, collection=col))
+    # Other nodes drop their shard files + mounts.
+    for n in nodes:
+        env.volume(n.url).VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=col,
+                shard_ids=n.shards[vid] if n is not collector
+                else list(have)))
+    env.println(f"ec.decode volume {vid}: restored on {collector.url}")
+
+
+@cluster_command("ec.balance")
+def cmd_ec_balance(env: ClusterEnv, argv: list[str]) -> None:
+    """Even out EC shard counts across servers (command_ec_balance.go):
+    move shards from the most-loaded to the least-loaded until spread."""
+    p = _parser("ec.balance")
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    moved = 0
+    for _round in range(100):
+        nodes = env.collect_ec_nodes()
+        if len(nodes) < 2:
+            break
+        nodes.sort(key=lambda n: n.shard_count())
+        low, high = nodes[0], nodes[-1]
+        if high.shard_count() - low.shard_count() <= 1:
+            break
+        # Move one shard the low node doesn't already hold for that vid.
+        pick: Optional[tuple[int, int]] = None
+        for vid, sids in high.shards.items():
+            for sid in sids:
+                if sid not in low.shards.get(vid, []):
+                    pick = (vid, sid)
+                    break
+            if pick:
+                break
+        if pick is None:
+            break
+        vid, sid = pick
+        env.volume(low.url).VolumeEcShardsCopy(
+            volume_server_pb2.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=args.collection,
+                shard_ids=[sid], copy_ecx_file=True, copy_vif_file=True,
+                source_data_node=high.url))
+        env.volume(low.url).VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=args.collection,
+                shard_ids=[sid]))
+        env.volume(high.url).VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=args.collection,
+                shard_ids=[sid]))
+        moved += 1
+    env.println(f"ec.balance: moved {moved} shards")
+
+
+@cluster_command("volume.list")
+def cmd_volume_list(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("volume.list")
+    p.parse_args(argv)
+    resp = env.volume_list()
+    for dc in resp.topology_info.data_center_infos:
+        env.println(f"DataCenter {dc.id}")
+        for rack in dc.rack_infos:
+            env.println(f"  Rack {rack.id}")
+            for dn in rack.data_node_infos:
+                env.println(f"    DataNode {dn.id} "
+                            f"volumes={dn.volume_count}/"
+                            f"{dn.max_volume_count}")
+                for v in dn.volume_infos:
+                    env.println(
+                        f"      volume {v.id} "
+                        f"collection={v.collection or '-'} "
+                        f"size={v.size} files={v.file_count}"
+                        + (" readonly" if v.read_only else ""))
+                for s in dn.ec_shard_infos:
+                    env.println(
+                        f"      ec volume {s.id} "
+                        f"collection={s.collection or '-'} "
+                        f"shards={ShardBits(s.ec_index_bits).ids()}")
+
+
+@cluster_command("volume.balance")
+def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
+    """Move whole volumes from loaded to free servers
+    (command_volume_balance.go, via VolumeCopy + delete)."""
+    p = _parser("volume.balance")
+    p.parse_args(argv)
+    moved = 0
+    for _round in range(100):
+        resp = env.volume_list()
+        counts: list[tuple[int, str, list]] = []
+        for dc in resp.topology_info.data_center_infos:
+            for rack in dc.rack_infos:
+                for dn in rack.data_node_infos:
+                    counts.append((dn.volume_count, dn.id,
+                                   list(dn.volume_infos)))
+        if len(counts) < 2:
+            break
+        counts.sort()
+        low_count, low_url, _ = counts[0]
+        high_count, high_url, high_vols = counts[-1]
+        if high_count - low_count <= 1 or not high_vols:
+            break
+        v = high_vols[0]
+        # Freeze the source first: it is deleted right after the copy,
+        # so no write may land in between (VolumeCopy docstring).
+        env.volume(high_url).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(
+                volume_id=v.id, collection=v.collection))
+        env.volume(low_url).VolumeCopy(
+            volume_server_pb2.VolumeCopyRequest(
+                volume_id=v.id, collection=v.collection,
+                source_data_node=high_url))
+        env.volume(high_url).VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(
+                volume_id=v.id, collection=v.collection))
+        moved += 1
+    env.println(f"volume.balance: moved {moved} volumes")
+
+
+@cluster_command("volume.fix.replication")
+def cmd_volume_fix_replication(env: ClusterEnv, argv: list[str]) -> None:
+    """Re-replicate under-replicated volumes (the recovery actuator the
+    reference cron-drives; command_volume_fix_replication.go)."""
+    from ..storage.superblock import ReplicaPlacement
+
+    p = _parser("volume.fix.replication")
+    p.parse_args(argv)
+    resp = env.volume_list()
+    # vid -> (collection, rp, holders)
+    vols: dict[int, tuple[str, int, list[str]]] = {}
+    all_nodes: list[str] = []
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                all_nodes.append(dn.id)
+                for v in dn.volume_infos:
+                    col, rp, holders = vols.get(
+                        v.id, (v.collection, v.replica_placement, []))
+                    holders.append(dn.id)
+                    vols[v.id] = (col, rp, holders)
+    fixed = 0
+    for vid, (col, rp_byte, holders) in sorted(vols.items()):
+        want = ReplicaPlacement.from_byte(rp_byte).copy_count()
+        if len(holders) >= want:
+            continue
+        spare = [u for u in all_nodes if u not in holders]
+        for target in spare[:want - len(holders)]:
+            env.volume(target).VolumeCopy(
+                volume_server_pb2.VolumeCopyRequest(
+                    volume_id=vid, collection=col,
+                    source_data_node=holders[0]))
+            env.println(f"volume.fix.replication: volume {vid} "
+                        f"copied {holders[0]} -> {target}")
+            fixed += 1
+    if not fixed:
+        env.println("volume.fix.replication: all volumes fully "
+                    "replicated")
+
+
+@cluster_command("volume.grow")
+def cmd_volume_grow(env: ClusterEnv, argv: list[str]) -> None:
+    """Pre-grow writable volumes via the master (/vol/grow)."""
+    import json
+    import urllib.request
+
+    p = _parser("volume.grow")
+    p.add_argument("-count", type=int, default=1)
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    args = p.parse_args(argv)
+    url = (f"http://{env.master_url}/vol/grow?count={args.count}"
+           f"&collection={args.collection}"
+           f"&replication={args.replication}")
+    req = urllib.request.Request(url, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        doc = json.loads(resp.read())
+    if "error" in doc:
+        raise ShellError(doc["error"])
+    env.println(f"volume.grow: created volumes {doc['volumeIds']}")
+
+
+@cluster_command("cluster.status")
+def cmd_cluster_status(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("cluster.status")
+    p.parse_args(argv)
+    resp = env.master().GetMasterConfiguration(
+        master_pb2.GetMasterConfigurationRequest())
+    env.println(f"master {env.master_url} "
+                f"volumeSizeLimit={resp.volume_size_limit} "
+                f"jwt={'on' if resp.jwt_enabled else 'off'}")
+    nodes = env.collect_ec_nodes()
+    env.println(f"{len(nodes)} data nodes")
+
+
+def run_cluster_command(env: ClusterEnv, line: str) -> None:
+    parts = shlex.split(line)
+    if not parts:
+        return
+    name, argv = parts[0], parts[1:]
+    if name in ("help", "?"):
+        for c in sorted(CLUSTER_COMMANDS):
+            env.println(c)
+        return
+    fn = CLUSTER_COMMANDS.get(name)
+    if fn is None:
+        raise ShellError(f"unknown command {name!r} (try 'help')")
+    try:
+        fn(env, argv)
+    except ShellError:
+        raise
+    except (argparse.ArgumentError, SystemExit) as e:
+        raise ShellError(f"{name}: bad arguments ({e})") from None
+    except Exception as e:
+        raise ShellError(f"{name}: {e}") from None

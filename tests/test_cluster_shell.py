@@ -1,0 +1,162 @@
+"""Cluster-mode shell commands against a live localhost cluster.
+
+The reference's shell is integration-tested against real servers; same
+here: ec.encode / ec.rebuild / ec.decode / volume.balance /
+volume.fix.replication choreograph actual master+volume processes
+(in-process threads) over gRPC.
+"""
+
+import io
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.cluster import operation
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.cluster.wdclient import MasterClient
+from seaweedfs_tpu.shell.cluster_commands import (
+    ClusterEnv, run_cluster_command)
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage.store import Store
+
+from test_cluster_integration import _free_port_pair
+
+PULSE = 0.2
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=1).start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        store = Store([d], max_volumes=8)
+        vs = VolumeServer(store, port=_free_port_pair(),
+                          master_url=master.url, data_center="dc1",
+                          rack=f"r{i % 2}", pulse_seconds=PULSE).start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 3:
+        time.sleep(0.05)
+    assert len(master.topology.nodes) == 3
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _env(master):
+    out = io.StringIO()
+    return ClusterEnv(master_url=master.url, out=out), out
+
+
+def _settle(servers):
+    for vs in servers:
+        vs.heartbeat_now()
+    time.sleep(0.05)
+
+
+def test_shell_ec_lifecycle(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    rng = np.random.default_rng(3)
+    blobs = [rng.integers(0, 256, 1500, dtype=np.uint8).tobytes()
+             for _ in range(15)]
+    fids = operation.submit(mc, blobs)
+    vid = int(fids[0].split(",")[0])
+    keep = [(f, b) for f, b in zip(fids, blobs)
+            if int(f.split(",")[0]) == vid]
+
+    env, out = _env(master)
+    run_cluster_command(env, f"ec.encode -volumeId {vid}")
+    assert "shards over" in out.getvalue()
+    _settle(servers)
+
+    # Shards are spread across servers; volume itself is gone.
+    assert not any(vs.store.has_volume(vid) for vs in servers)
+    holders = [vs for vs in servers
+               if any(v == vid for (_c, v) in vs.store.ec_mounts)]
+    assert len(holders) >= 2
+
+    # Reads work through EC.
+    mc.invalidate()
+    for fid, want in keep:
+        assert operation.download(mc, fid) == want
+
+    # volume.list shows the ec volume.
+    run_cluster_command(env, "volume.list")
+    assert f"ec volume {vid}" in out.getvalue()
+
+    # Lose one shard server's worth: delete one shard file.
+    victim = holders[0]
+    m = next(m for (c, v), m in victim.store.ec_mounts.items()
+             if v == vid)
+    lost = sorted(m.shard_ids)[0]
+    ec_files.shard_path(m.base, lost).unlink()
+    victim.store.unmount_ec_shards(vid, [lost])
+    _settle(servers)
+
+    run_cluster_command(env, "ec.rebuild")
+    assert f"rebuilt [{lost}]" in out.getvalue()
+    _settle(servers)
+    # All 14 shards live again.
+    locs = master.topology.lookup_ec_volume(vid)
+    assert sorted(locs) == list(range(14))
+
+    # ec.decode brings the normal volume back, readable.
+    run_cluster_command(env, f"ec.decode -volumeId {vid}")
+    _settle(servers)
+    assert any(vs.store.has_volume(vid) for vs in servers)
+    mc.invalidate()
+    for fid, want in keep:
+        assert operation.download(mc, fid) == want
+    mc.close()
+    env.close()
+
+
+def test_shell_volume_balance_and_fix_replication(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    # Several volumes, all created on demand (likely uneven).
+    for i in range(6):
+        operation.submit(mc, [b"x" * 500])
+        master.grow_volume()
+    _settle(servers)
+
+    env, out = _env(master)
+    run_cluster_command(env, "volume.balance")
+    _settle(servers)
+    counts = [len(vs.store.volumes) for vs in servers]
+    assert max(counts) - min(counts) <= 1
+
+    # Under-replicate: a 010 volume with one copy deleted.
+    a = operation.assign(mc, collection="r", replication="010")
+    operation.upload(a.url, a.fid, b"fixme", collection="r")
+    vid = int(a.fid.split(",")[0])
+    _settle(servers)
+    holder = next(vs for vs in servers if vs.store.has_volume(vid, "r"))
+    holder.store.delete_volume(vid, "r")
+    _settle(servers)
+    before = sum(vs.store.has_volume(vid, "r") for vs in servers)
+    assert before == 1
+    run_cluster_command(env, "volume.fix.replication")
+    _settle(servers)
+    after = sum(vs.store.has_volume(vid, "r") for vs in servers)
+    assert after == 2
+    assert "copied" in out.getvalue()
+    mc.close()
+    env.close()
+
+
+def test_shell_cluster_status_and_grow(cluster):
+    master, servers = cluster
+    env, out = _env(master)
+    run_cluster_command(env, "cluster.status")
+    assert "3 data nodes" in out.getvalue()
+    run_cluster_command(env, "volume.grow -count 2")
+    assert "created volumes" in out.getvalue()
+    env.close()
