@@ -48,7 +48,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from seaweedfs_tpu.ops import bitslice
+    from seaweedfs_tpu.ops import bitslice, rs_pallas
     from seaweedfs_tpu.ops.rs_jax import Encoder
 
     dev = jax.devices()[0]
@@ -59,20 +59,24 @@ def main() -> None:
     k, m = 10, 4
     enc = Encoder(k, m)
     coefs = enc.parity_coefs
+    seg = rs_pallas.SEG_BYTES
 
-    # (B, k, S): ~1 GiB total input, S a multiple of the packing group.
+    # (B, k, S): ~1 GiB total input, S aligned to the Pallas segment.
     batch = 8 if on_tpu else 1
-    s = (GIB // (batch * k)) // 128 * 128
+    s = (GIB // (batch * k)) // seg * seg
     if not on_tpu:
         # CPU smoke: shrink to keep runtime sane (keep group alignment).
-        s = (s // 64) // 128 * 128
+        s = max(seg, (s // 64) // seg * seg)
     total_bytes = batch * k * s
     log(f"encode shape: ({batch}, {k}, {s}) = "
         f"{total_bytes / GIB:.4f} GiB input")
 
+    gf_apply = rs_pallas.apply_gf_matrix if on_tpu else \
+        bitslice.apply_gf_matrix
+
     @jax.jit
     def encode_fn(x):
-        return bitslice.apply_gf_matrix(coefs, x)
+        return gf_apply(coefs, x)
 
     key = jax.random.PRNGKey(0)
     x = jax.random.randint(key, (batch, k, s), 0, 256, dtype=jnp.uint8)
@@ -91,7 +95,7 @@ def main() -> None:
 
     @jax.jit
     def rebuild_fn(surv):
-        return bitslice.apply_gf_matrix(rebuild_coefs, surv)
+        return gf_apply(rebuild_coefs, surv)
 
     t_r = timeit(rebuild_fn, x)  # x's first 10 rows stand in as survivors
     rebuild_gibps = total_bytes / GIB / t_r
@@ -102,13 +106,13 @@ def main() -> None:
     for (ak, am) in ((6, 3), (12, 4)):
         aenc = Encoder(ak, am)
         acoefs = aenc.parity_coefs
-        a_s = (total_bytes // (batch * ak)) // 128 * 128
+        a_s = (total_bytes // (batch * ak)) // seg * seg
         ax = jax.random.randint(key, (batch, ak, a_s), 0, 256,
                                 dtype=jnp.uint8)
 
         @jax.jit
         def alt_fn(v, _c=acoefs):
-            return bitslice.apply_gf_matrix(_c, v)
+            return gf_apply(_c, v)
 
         t_a = timeit(alt_fn, ax, warmup=1, iters=3)
         log(f"RS({ak},{am}) encode: "

@@ -330,6 +330,10 @@ class _VolumeServicer:
         self.vs.store.mark_readonly(request.volume_id, request.collection)
         return volume_server_pb2.VolumeMarkReadonlyResponse()
 
+    def VolumeMarkWritable(self, request, context):
+        self.vs.store.mark_writable(request.volume_id, request.collection)
+        return volume_server_pb2.VolumeMarkWritableResponse()
+
     def VolumeStatus(self, request, context):
         resp = volume_server_pb2.VolumeStatusResponse()
         store = self.vs.store
@@ -483,6 +487,7 @@ class _VolumeServicer:
                     p.unlink()
         vs.store.mount_ec_shards(request.volume_id, rebuilt,
                                  request.collection)
+        vs.heartbeat_now()
         resp.rebuilt_shard_ids.extend(rebuilt)
         return resp
 
@@ -509,6 +514,7 @@ class _VolumeServicer:
             _copy_remote_file(vs, src, request.volume_id,
                               request.collection, ".vif",
                               ec_files.vif_path(base))
+        vs.heartbeat_now()
         return volume_server_pb2.VolumeEcShardsCopyResponse()
 
     def VolumeEcShardsDelete(self, request, context):
@@ -521,17 +527,20 @@ class _VolumeServicer:
         self.vs.store.unmount_ec_shards(
             request.volume_id, list(request.shard_ids),
             request.collection)
+        self.vs.heartbeat_now()
         return volume_server_pb2.VolumeEcShardsDeleteResponse()
 
     def VolumeEcShardsMount(self, request, context):
         self.vs.store.mount_ec_shards(
             request.volume_id, list(request.shard_ids),
             request.collection)
+        self.vs.heartbeat_now()
         return volume_server_pb2.VolumeEcShardsMountResponse()
 
     def VolumeEcShardsUnmount(self, request, context):
         self.vs.store.unmount_ec_shards(
             request.volume_id, list(request.shard_ids))
+        self.vs.heartbeat_now()
         return volume_server_pb2.VolumeEcShardsUnmountResponse()
 
     def VolumeEcShardRead(self, request, context):
@@ -570,6 +579,7 @@ class _VolumeServicer:
             request.volume_id,
             list(range(scheme.total_shards)), request.collection)
         self.vs.store.load_existing()
+        self.vs.heartbeat_now()
         return volume_server_pb2.VolumeEcShardsToVolumeResponse()
 
     def VolumeEcBlobDelete(self, request, context):

@@ -26,27 +26,56 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import bitslice, gf256
+from . import bitslice, gf256, rs_pallas
 from .rs_ref import ShardSizeError, TooFewShardsError
 
 GROUP = bitslice.GROUP_BYTES
 
+#: Use the fused Pallas kernel on TPU once a shard is at least this long
+#: (below it, the pad to rs_pallas.SEG_BYTES and grid overhead dominate).
+PALLAS_MIN_S = 256 * 1024
+#: Chunk the pure-XLA path along S above this, bounding the ~12x word
+#: expansion its unfused pack/XOR/unpack intermediates cost in HBM/RAM.
+XLA_CHUNK_S = 4 * 1024 * 1024
+
+
+def _use_pallas() -> bool:
+    # Mosaic kernels lower only for TPU ("axon" is this environment's
+    # tunneled TPU plugin); GPU/CPU take the XLA bitslice network.
+    return jax.default_backend() in ("tpu", "axon")
+
 
 @functools.lru_cache(maxsize=256)
-def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int):
-    """One jitted executable per coefficient matrix (shapes polymorphic
-    via jit's own shape cache)."""
+def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
+    """One jitted executable per (coefficient matrix, backend variant);
+    shapes stay polymorphic via jit's own shape cache."""
     coefs = np.frombuffer(coefs_bytes, dtype=np.uint8).reshape(n_out, n_in)
 
-    @jax.jit
-    def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
-        return bitslice.apply_gf_matrix(coefs, x)
+    if variant == "pallas":
+        @jax.jit
+        def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
+            return rs_pallas.apply_gf_matrix(coefs, x)
+    elif variant == "xla":
+        @jax.jit
+        def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
+            return bitslice.apply_gf_matrix(coefs, x)
+    else:  # "xla_chunked": x is (B, n_in, nc, sc)
+        @jax.jit
+        def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
+            # lax.map over column chunks keeps live intermediates to one
+            # chunk's worth while XLA still fuses within each step.
+            xc = x.transpose(2, 0, 1, 3)
+            yc = jax.lax.map(
+                lambda v: bitslice.apply_gf_matrix(coefs, v), xc)
+            return yc.transpose(1, 2, 0, 3)
 
     return apply_fn
 
 
 def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
-    """Pad-to-group, run the cached executable, slice back."""
+    """Dispatch to the fused Pallas kernel (TPU) or the chunked XLA
+    network, padding S to the chosen path's granularity and slicing back
+    (zero bytes encode to zero parity, so padding is transparent)."""
     coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
     n_out, n_in = coefs.shape
     x = jnp.asarray(x, dtype=jnp.uint8)
@@ -55,12 +84,27 @@ def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
     squeeze = x.ndim == 2
     if squeeze:
         x = x[None]
-    s = x.shape[-1]
-    pad = (-s) % GROUP
+    b, _, s = x.shape
+    if _use_pallas() and s >= PALLAS_MIN_S:
+        variant, seg = "pallas", rs_pallas.SEG_BYTES
+        nc = 1
+    elif s > XLA_CHUNK_S:
+        variant = "xla_chunked"
+        nc = -(-s // XLA_CHUNK_S)
+        sc = -(-(-(-s // nc)) // GROUP) * GROUP  # ceil(s/nc) up to GROUP
+        seg = nc * sc
+    else:
+        variant, seg = "xla", GROUP
+        nc = 1
+    pad = (-s) % seg
     if pad:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
-    fn = _jitted_apply(coefs.tobytes(), n_out, n_in)
+    if variant == "xla_chunked":
+        x = x.reshape(b, n_in, nc, (s + pad) // nc)
+    fn = _jitted_apply(coefs.tobytes(), n_out, n_in, variant)
     y = fn(x)
+    if variant == "xla_chunked":
+        y = y.reshape(b, n_out, s + pad)
     if pad:
         y = y[..., :s]
     return y[0] if squeeze else y

@@ -55,6 +55,8 @@ VOLUME_METHODS = [
            volume_server_pb2.VolumeDeleteResponse),
     Method("VolumeMarkReadonly", volume_server_pb2.VolumeMarkReadonlyRequest,
            volume_server_pb2.VolumeMarkReadonlyResponse),
+    Method("VolumeMarkWritable", volume_server_pb2.VolumeMarkWritableRequest,
+           volume_server_pb2.VolumeMarkWritableResponse),
     Method("VolumeStatus", volume_server_pb2.VolumeStatusRequest,
            volume_server_pb2.VolumeStatusResponse),
     Method("CopyFile", volume_server_pb2.CopyFileRequest,

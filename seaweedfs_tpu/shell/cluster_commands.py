@@ -214,18 +214,21 @@ def cmd_ec_rebuild(env: ClusterEnv, argv: list[str]) -> None:
         if not have:
             env.println(f"ec.rebuild volume {vid}: no shards anywhere")
             continue
-        total = 14 if max(have) < 14 else max(have) + 1
-        missing = sorted(set(range(total)) - have)
-        if not missing:
-            env.println(f"ec.rebuild volume {vid}: all shards present")
-            continue
+        # The geometry (k+m) lives in the .vif next to the shards, so the
+        # rebuilder server is authoritative about which shards are
+        # missing — never guess totals from shard ids here (a (12,4)
+        # volume would silently skip, a (6,3) one would churn).
         rebuilder = max(holders[vid],
                         key=lambda n: len(n.shards.get(vid, [])))
         resp = env.volume(rebuilder.url).VolumeEcShardsRebuild(
             volume_server_pb2.VolumeEcShardsRebuildRequest(
                 volume_id=vid, collection=args.collection))
-        env.println(f"ec.rebuild volume {vid}: rebuilt "
-                    f"{list(resp.rebuilt_shard_ids)} on {rebuilder.url}")
+        if resp.rebuilt_shard_ids:
+            env.println(f"ec.rebuild volume {vid}: rebuilt "
+                        f"{list(resp.rebuilt_shard_ids)} on "
+                        f"{rebuilder.url}")
+        else:
+            env.println(f"ec.rebuild volume {vid}: all shards present")
 
 
 @cluster_command("ec.decode")
@@ -357,20 +360,37 @@ def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
         if len(counts) < 2:
             break
         counts.sort()
-        low_count, low_url, _ = counts[0]
+        low_count, low_url, low_vols = counts[0]
         high_count, high_url, high_vols = counts[-1]
         if high_count - low_count <= 1 or not high_vols:
             break
-        v = high_vols[0]
+        # The destination may already hold a replica of some of the
+        # high node's volumes — pick the first it does not.
+        low_ids = {(v.collection, v.id) for v in low_vols}
+        movable = [v for v in high_vols
+                   if (v.collection, v.id) not in low_ids]
+        if not movable:
+            break
+        v = movable[0]
         # Freeze the source first: it is deleted right after the copy,
         # so no write may land in between (VolumeCopy docstring).
         env.volume(high_url).VolumeMarkReadonly(
             volume_server_pb2.VolumeMarkReadonlyRequest(
                 volume_id=v.id, collection=v.collection))
-        env.volume(low_url).VolumeCopy(
-            volume_server_pb2.VolumeCopyRequest(
-                volume_id=v.id, collection=v.collection,
-                source_data_node=high_url))
+        try:
+            env.volume(low_url).VolumeCopy(
+                volume_server_pb2.VolumeCopyRequest(
+                    volume_id=v.id, collection=v.collection,
+                    source_data_node=high_url))
+        except Exception as e:
+            # Thaw the source so a failed move never leaves the volume
+            # stuck readonly (Store.readonly is in-memory only).
+            env.volume(high_url).VolumeMarkWritable(
+                volume_server_pb2.VolumeMarkWritableRequest(
+                    volume_id=v.id, collection=v.collection))
+            raise ShellError(
+                f"volume.balance: copy of volume {v.id} to {low_url} "
+                f"failed ({e}); source thawed") from e
         env.volume(high_url).VolumeDelete(
             volume_server_pb2.VolumeDeleteRequest(
                 volume_id=v.id, collection=v.collection))

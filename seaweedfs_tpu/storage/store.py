@@ -175,6 +175,11 @@ class Store:
         self.get_volume(volume_id, collection)  # must exist
         self.readonly.add((collection, volume_id))
 
+    def mark_writable(self, volume_id: int, collection: str = "") -> None:
+        """VolumeMarkWritable: undo a freeze (balance rollback path)."""
+        self.get_volume(volume_id, collection)  # must exist
+        self.readonly.discard((collection, volume_id))
+
     def is_readonly(self, volume_id: int, collection: str = "") -> bool:
         return (collection, volume_id) in self.readonly
 
