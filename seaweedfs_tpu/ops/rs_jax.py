@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import bitslice, gf256, rs_pallas
+from . import bitslice, gf256, rs_native, rs_pallas
 from .rs_ref import ShardSizeError, TooFewShardsError
 
 GROUP = bitslice.GROUP_BYTES
@@ -37,12 +37,27 @@ PALLAS_MIN_S = 256 * 1024
 #: Chunk the pure-XLA path along S above this, bounding the ~12x word
 #: expansion its unfused pack/XOR/unpack intermediates cost in HBM/RAM.
 XLA_CHUNK_S = 4 * 1024 * 1024
+#: Test/debug override: "pallas" | "native" | "xla" | None (auto).
+FORCE: Optional[str] = None
 
 
 def _use_pallas() -> bool:
     # Mosaic kernels lower only for TPU ("axon" is this environment's
     # tunneled TPU plugin); GPU/CPU take the XLA bitslice network.
     return jax.default_backend() in ("tpu", "axon")
+
+
+def _pick_variant(s: int) -> str:
+    if FORCE:
+        return FORCE
+    if _use_pallas() and s >= PALLAS_MIN_S:
+        return "pallas"
+    if jax.default_backend() == "cpu" and rs_native.available():
+        # Measured on this host: the AVX2 nibble-LUT codec beats the
+        # XLA:CPU bitslice network ~10x, so it IS the CPU fallback
+        # (the reference's "falls back to SIMD CPU path").
+        return "native"
+    return "xla"
 
 
 @functools.lru_cache(maxsize=256)
@@ -78,24 +93,30 @@ def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
     (zero bytes encode to zero parity, so padding is transparent)."""
     coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
     n_out, n_in = coefs.shape
-    x = jnp.asarray(x, dtype=jnp.uint8)
-    if x.ndim not in (2, 3):
-        raise ValueError(f"expected (n_in, S) or (B, n_in, S), got {x.shape}")
+    if getattr(x, "ndim", None) not in (2, 3):
+        raise ValueError(
+            f"expected (n_in, S) or (B, n_in, S), got {getattr(x, 'shape', x)}")
     squeeze = x.ndim == 2
+    variant = _pick_variant(x.shape[-1])
+    if variant == "native":
+        # Stay on the host end to end — converting through a device
+        # buffer first would add two full copies of the payload.
+        y = rs_native.apply_gf_matrix(coefs, np.asarray(x, dtype=np.uint8))
+        return jnp.asarray(y)
+    x = jnp.asarray(x, dtype=jnp.uint8)
     if squeeze:
         x = x[None]
     b, _, s = x.shape
-    if _use_pallas() and s >= PALLAS_MIN_S:
-        variant, seg = "pallas", rs_pallas.SEG_BYTES
-        nc = 1
-    elif s > XLA_CHUNK_S:
+    nc = 1
+    if variant == "pallas":
+        seg = rs_pallas.SEG_BYTES
+    elif variant == "xla" and s > XLA_CHUNK_S:
         variant = "xla_chunked"
         nc = -(-s // XLA_CHUNK_S)
         sc = -(-(-(-s // nc)) // GROUP) * GROUP  # ceil(s/nc) up to GROUP
         seg = nc * sc
     else:
         variant, seg = "xla", GROUP
-        nc = 1
     pad = (-s) % seg
     if pad:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))

@@ -1,0 +1,146 @@
+"""ctypes bridge to the native GF(2^8) codec (native/gf256_rs.cpp).
+
+The reference links its Go code against SIMD Galois assembly
+(klauspost/reedsolomon galois_amd64.s, SURVEY.md §2 L0); here the native
+half is a small C++ library compiled on first use with the baked-in g++
+and driven over ctypes (no pybind11 in this environment). Python threads
+can fan one large apply out across column chunks because the C calls
+release the GIL.
+
+Roles: AVX2-class CPU baseline for bench.py, and the host-side fast path
+for small interval repairs where a device round-trip costs more than the
+math (read path, config 5).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parent.parent / "native" / "gf256_rs.cpp"
+_SO = _SRC.with_name("_gf256_rs.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_pool: Optional[ThreadPoolExecutor] = None
+
+#: Column chunk per worker thread when fanning out (bytes).
+THREAD_CHUNK = 8 * 1024 * 1024
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> Path:
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    # Per-process temp name: concurrent builders (two servers starting on
+    # a fresh checkout) each compile privately, then atomically rename —
+    # last one wins, nobody ever dlopens a half-written file.
+    tmp = _SO.with_suffix(f".so.tmp{os.getpid()}")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        tmp.replace(_SO)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise NativeUnavailable(f"g++ build failed: {detail}") from e
+    finally:
+        tmp.unlink(missing_ok=True)
+    return _SO
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(str(_build()))
+            lib.gf256_init.restype = None
+            lib.gf256_simd_level.restype = ctypes.c_int
+            lib.rs_apply.restype = None
+            lib.rs_apply.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.c_size_t]
+            lib.gf256_init()
+            _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def simd_level() -> int:
+    """0 = scalar, 2 = AVX2."""
+    return int(_load().gf256_simd_level())
+
+
+def _ptr(a: np.ndarray, offset: int = 0):
+    return ctypes.cast(a.ctypes.data + offset,
+                       ctypes.POINTER(ctypes.c_uint8))
+
+
+def _apply_2d(lib, coefs: np.ndarray, x: np.ndarray, out: np.ndarray,
+              threads: int) -> None:
+    n_out, n_in = coefs.shape
+    s = x.shape[-1]
+    cp = _ptr(coefs)
+    if threads <= 1 or s < 2 * THREAD_CHUNK:
+        lib.rs_apply(cp, n_out, n_in, _ptr(x), s, _ptr(out), s, s)
+        return
+    global _pool
+    if _pool is None:
+        _pool = ThreadPoolExecutor(max_workers=8)
+    n_chunks = min(threads, -(-s // THREAD_CHUNK))
+    bounds = [s * i // n_chunks for i in range(n_chunks + 1)]
+    futs = []
+    for lo, hi in zip(bounds, bounds[1:]):
+        # Column windows are zero-copy: same row strides, offset base
+        # pointers. ctypes calls release the GIL, so chunks run on all
+        # cores in parallel.
+        futs.append(_pool.submit(
+            lib.rs_apply, cp, n_out, n_in,
+            _ptr(x, lo), s, _ptr(out, lo), s, hi - lo))
+    for f in futs:
+        f.result()
+
+
+def apply_gf_matrix(coefs: np.ndarray, x: np.ndarray,
+                    threads: int = 4) -> np.ndarray:
+    """y[..., o, s] = XOR_d coefs[o, d] * x[..., d, s] on the host CPU.
+
+    Same contract as bitslice/rs_pallas.apply_gf_matrix but pure numpy
+    in/out, arbitrary S (no padding requirement).
+    """
+    lib = _load()
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    n_out, n_in = coefs.shape
+    x = np.ascontiguousarray(x, dtype=np.uint8)
+    if x.ndim == 2:
+        if x.shape[0] != n_in:
+            raise ValueError(f"x must be ({n_in}, S), got {x.shape}")
+        out = np.empty((n_out, x.shape[1]), dtype=np.uint8)
+        _apply_2d(lib, coefs, x, out, threads)
+        return out
+    if x.ndim == 3:
+        if x.shape[1] != n_in:
+            raise ValueError(f"x must be (B, {n_in}, S), got {x.shape}")
+        out = np.empty((x.shape[0], n_out, x.shape[2]), dtype=np.uint8)
+        for b in range(x.shape[0]):
+            _apply_2d(lib, coefs, x[b], out[b], threads)
+        return out
+    raise ValueError(f"expected (n_in, S) or (B, n_in, S), got {x.shape}")

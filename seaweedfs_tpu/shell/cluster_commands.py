@@ -30,6 +30,7 @@ class EcNode:
     rack: str
     free_slots: int
     shards: dict[int, list[int]]  # vid -> shard ids here
+    collections: dict[int, str] = field(default_factory=dict)  # vid -> col
 
     def shard_count(self) -> int:
         return sum(len(s) for s in self.shards.values())
@@ -88,11 +89,14 @@ class ClusterEnv:
             for rack in dc.rack_infos:
                 for dn in rack.data_node_infos:
                     shards: dict[int, list[int]] = {}
+                    cols: dict[int, str] = {}
                     for s in dn.ec_shard_infos:
                         shards[s.id] = ShardBits(s.ec_index_bits).ids()
+                        cols[s.id] = s.collection
                     nodes.append(EcNode(
                         url=dn.id, data_center=dc.id, rack=rack.id,
-                        free_slots=dn.free_volume_count, shards=shards))
+                        free_slots=dn.free_volume_count, shards=shards,
+                        collections=cols))
         return nodes
 
     def volume_locations(self, vid: int) -> list[str]:
@@ -201,18 +205,26 @@ def cmd_ec_rebuild(env: ClusterEnv, argv: list[str]) -> None:
     p.add_argument("-collection", default="")
     args = p.parse_args(argv)
     nodes = env.collect_ec_nodes()
-    # vid -> {shard ids present anywhere}
+    # vid -> {shard ids present anywhere}; collection comes from the
+    # heartbeat-reported shard info, NOT from the flag, so the RPC always
+    # names the volume's real collection.
     present: dict[int, set[int]] = {}
     holders: dict[int, list[EcNode]] = {}
+    col_of: dict[int, str] = {}
     for n in nodes:
         for vid, sids in n.shards.items():
             present.setdefault(vid, set()).update(sids)
             holders.setdefault(vid, []).append(n)
+            col_of.setdefault(vid, n.collections.get(vid, ""))
     todo = [args.volumeId] if args.volumeId else sorted(present)
+    failures = 0
     for vid in todo:
         have = present.get(vid, set())
         if not have:
             env.println(f"ec.rebuild volume {vid}: no shards anywhere")
+            continue
+        col = col_of.get(vid, "")
+        if args.collection and col != args.collection:
             continue
         # The geometry (k+m) lives in the .vif next to the shards, so the
         # rebuilder server is authoritative about which shards are
@@ -220,15 +232,24 @@ def cmd_ec_rebuild(env: ClusterEnv, argv: list[str]) -> None:
         # volume would silently skip, a (6,3) one would churn).
         rebuilder = max(holders[vid],
                         key=lambda n: len(n.shards.get(vid, [])))
-        resp = env.volume(rebuilder.url).VolumeEcShardsRebuild(
-            volume_server_pb2.VolumeEcShardsRebuildRequest(
-                volume_id=vid, collection=args.collection))
+        try:
+            resp = env.volume(rebuilder.url).VolumeEcShardsRebuild(
+                volume_server_pb2.VolumeEcShardsRebuildRequest(
+                    volume_id=vid, collection=col))
+        except Exception as e:
+            # One broken volume must not abort the whole sweep.
+            env.println(f"ec.rebuild volume {vid}: failed on "
+                        f"{rebuilder.url}: {e}")
+            failures += 1
+            continue
         if resp.rebuilt_shard_ids:
             env.println(f"ec.rebuild volume {vid}: rebuilt "
                         f"{list(resp.rebuilt_shard_ids)} on "
                         f"{rebuilder.url}")
         else:
             env.println(f"ec.rebuild volume {vid}: all shards present")
+    if failures:
+        raise ShellError(f"ec.rebuild: {failures} volume(s) failed")
 
 
 @cluster_command("ec.decode")
@@ -290,6 +311,9 @@ def cmd_ec_balance(env: ClusterEnv, argv: list[str]) -> None:
         # Move one shard the low node doesn't already hold for that vid.
         pick: Optional[tuple[int, int]] = None
         for vid, sids in high.shards.items():
+            if (args.collection
+                    and high.collections.get(vid, "") != args.collection):
+                continue
             for sid in sids:
                 if sid not in low.shards.get(vid, []):
                     pick = (vid, sid)
@@ -299,18 +323,19 @@ def cmd_ec_balance(env: ClusterEnv, argv: list[str]) -> None:
         if pick is None:
             break
         vid, sid = pick
+        col = high.collections.get(vid, "")
         env.volume(low.url).VolumeEcShardsCopy(
             volume_server_pb2.VolumeEcShardsCopyRequest(
-                volume_id=vid, collection=args.collection,
+                volume_id=vid, collection=col,
                 shard_ids=[sid], copy_ecx_file=True, copy_vif_file=True,
                 source_data_node=high.url))
         env.volume(low.url).VolumeEcShardsMount(
             volume_server_pb2.VolumeEcShardsMountRequest(
-                volume_id=vid, collection=args.collection,
+                volume_id=vid, collection=col,
                 shard_ids=[sid]))
         env.volume(high.url).VolumeEcShardsDelete(
             volume_server_pb2.VolumeEcShardsDeleteRequest(
-                volume_id=vid, collection=args.collection,
+                volume_id=vid, collection=col,
                 shard_ids=[sid]))
         moved += 1
     env.println(f"ec.balance: moved {moved} shards")
@@ -384,13 +409,19 @@ def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
                     source_data_node=high_url))
         except Exception as e:
             # Thaw the source so a failed move never leaves the volume
-            # stuck readonly (Store.readonly is in-memory only).
-            env.volume(high_url).VolumeMarkWritable(
-                volume_server_pb2.VolumeMarkWritableRequest(
-                    volume_id=v.id, collection=v.collection))
+            # stuck readonly (Store.readonly is in-memory only). The
+            # thaw itself may fail (source down) — report both, never
+            # let it mask the original copy error.
+            thaw = "source thawed"
+            try:
+                env.volume(high_url).VolumeMarkWritable(
+                    volume_server_pb2.VolumeMarkWritableRequest(
+                        volume_id=v.id, collection=v.collection))
+            except Exception as e2:
+                thaw = f"thaw also failed: {e2}"
             raise ShellError(
                 f"volume.balance: copy of volume {v.id} to {low_url} "
-                f"failed ({e}); source thawed") from e
+                f"failed ({e}); {thaw}") from e
         env.volume(high_url).VolumeDelete(
             volume_server_pb2.VolumeDeleteRequest(
                 volume_id=v.id, collection=v.collection))

@@ -71,6 +71,7 @@ def test_conforms_and_shape_errors():
 
 def test_chunked_xla_path_matches(monkeypatch):
     """apply_matrix's lax.map column chunking is bit-transparent."""
+    monkeypatch.setattr(rs_jax, "FORCE", "xla")
     monkeypatch.setattr(rs_jax, "XLA_CHUNK_S", 512)
     rng = np.random.default_rng(7)
     x = rng.integers(0, 256, (2, 5, 1900), dtype=np.uint8)  # pads to 2048
