@@ -40,10 +40,40 @@ def _run_scaffold(argv: list[str]) -> int:
     return 0
 
 
+def _run_filer(argv: list[str]) -> int:
+    from .cluster.filer_server import main
+    return main(argv)
+
+
+def _run_upload(argv: list[str]) -> int:
+    from .cli_tools import run_upload
+    return run_upload(argv)
+
+
+def _run_download(argv: list[str]) -> int:
+    from .cli_tools import run_download
+    return run_download(argv)
+
+
+def _run_delete(argv: list[str]) -> int:
+    from .cli_tools import run_delete
+    return run_delete(argv)
+
+
+def _run_benchmark(argv: list[str]) -> int:
+    from .cli_tools import run_benchmark
+    return run_benchmark(argv)
+
+
 COMMANDS = {
     "shell": _run_shell,
     "master": _run_master,
     "volume": _run_volume,
+    "filer": _run_filer,
+    "upload": _run_upload,
+    "download": _run_download,
+    "delete": _run_delete,
+    "benchmark": _run_benchmark,
     "scaffold": _run_scaffold,
 }
 

@@ -1,0 +1,161 @@
+"""Client-side CLI tools: upload, download, delete, benchmark.
+
+Mirrors weed/command/{upload,download,benchmark}.go (SURVEY.md §2 "CLI
+dispatcher", "Benchmark"): thin drivers over the operation client. The
+benchmark is the reference's built-in load generator — N concurrent
+writers then readers of small files against a live cluster, reporting
+req/s and latency percentiles — doubling as an integration smoke test
+(SURVEY.md §4 "Load/benchmark as test").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+
+from .cluster import operation
+from .cluster.wdclient import MasterClient
+
+
+def run_upload(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="upload")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("files", nargs="+")
+    args = p.parse_args(argv)
+    master = MasterClient(args.master)
+    results = []
+    for f in args.files:
+        data = Path(f).read_bytes()
+        a = operation.assign(master, 1, args.collection,
+                             args.replication, args.ttl)
+        operation.upload(a.url, a.fid, data, jwt=a.auth,
+                         collection=args.collection)
+        results.append({"file": f, "fid": a.fid, "size": len(data),
+                        "url": f"{a.public_url}/{a.fid}"})
+    print(json.dumps(results, indent=2))
+    master.close()
+    return 0
+
+
+def run_download(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="download")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-dir", default=".")
+    p.add_argument("fids", nargs="+")
+    args = p.parse_args(argv)
+    master = MasterClient(args.master)
+    for fid in args.fids:
+        data = operation.download(master, fid,
+                                  collection=args.collection)
+        out = Path(args.dir) / fid.replace(",", "_")
+        out.write_bytes(data)
+        print(f"{fid} -> {out} ({len(data)} bytes)")
+    master.close()
+    return 0
+
+
+def run_delete(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="delete")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("fids", nargs="+")
+    args = p.parse_args(argv)
+    master = MasterClient(args.master)
+    for fid in args.fids:
+        operation.delete(master, fid, collection=args.collection)
+        print(f"deleted {fid}")
+    master.close()
+    return 0
+
+
+def _percentiles(xs: list[float]) -> dict[str, float]:
+    if not xs:
+        return {}
+    a = np.asarray(xs)
+    return {"p50_ms": float(np.percentile(a, 50) * 1e3),
+            "p90_ms": float(np.percentile(a, 90) * 1e3),
+            "p99_ms": float(np.percentile(a, 99) * 1e3),
+            "max_ms": float(a.max() * 1e3)}
+
+
+def run_benchmark(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="benchmark")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-c", type=int, default=4, help="concurrency")
+    p.add_argument("-n", type=int, default=100, help="file count")
+    p.add_argument("-size", type=int, default=1024, help="bytes per file")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-write-only", action="store_true")
+    args = p.parse_args(argv)
+    master = MasterClient(args.master)
+    rng = np.random.default_rng(0)
+    payloads = [rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
+                for _ in range(min(args.n, 64))]
+
+    fids: list[str] = []
+    write_times: list[float] = []
+
+    def write_one(i: int) -> tuple[str, float, bytes]:
+        data = payloads[i % len(payloads)]
+        t0 = time.perf_counter()
+        a = operation.assign(master, 1, args.collection,
+                             args.replication)
+        operation.upload(a.url, a.fid, data, jwt=a.auth,
+                         collection=args.collection)
+        return a.fid, time.perf_counter() - t0, data
+
+    t_start = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=args.c) as pool:
+        out = list(pool.map(write_one, range(args.n)))
+    write_wall = time.perf_counter() - t_start
+    by_fid = {}
+    for fid, dt, data in out:
+        fids.append(fid)
+        write_times.append(dt)
+        by_fid[fid] = data
+    wstats = _percentiles(write_times)
+    print(f"write: {args.n} files x {args.size} B, "
+          f"{args.n / write_wall:.1f} req/s, "
+          f"{args.n * args.size / write_wall / 2**20:.2f} MiB/s, "
+          f"{wstats}", file=sys.stderr)
+
+    if not args.write_only:
+        read_times: list[float] = []
+        mismatches = 0
+
+        def read_one(fid: str) -> tuple[float, bool]:
+            t0 = time.perf_counter()
+            data = operation.download(master, fid,
+                                      collection=args.collection)
+            return time.perf_counter() - t0, data == by_fid[fid]
+
+        t_start = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=args.c) as pool:
+            res = list(pool.map(read_one, fids))
+        read_wall = time.perf_counter() - t_start
+        for dt, ok in res:
+            read_times.append(dt)
+            mismatches += 0 if ok else 1
+        rstats = _percentiles(read_times)
+        print(f"read: {len(fids)} files, {len(fids) / read_wall:.1f} "
+              f"req/s, {mismatches} mismatches, {rstats}",
+              file=sys.stderr)
+        if mismatches:
+            master.close()
+            return 1
+    print(json.dumps({"written": args.n,
+                      "write_req_s": round(args.n / write_wall, 1),
+                      **{k: round(v, 2) for k, v in wstats.items()}}))
+    master.close()
+    return 0

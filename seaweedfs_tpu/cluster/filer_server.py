@@ -1,0 +1,431 @@
+"""Filer server: HTTP path API + filer gRPC service over a Filer.
+
+Mirrors weed/server/filer_server*.go + filer_grpc_server*.go (SURVEY.md
+§2 "Filer server"): HTTP GET resolves an entry's chunk list and streams
+the bytes back from volume servers; PUT/POST auto-chunk the body through
+assign+upload before committing the entry; DELETE reclaims chunks.
+Directory GETs return JSON listings. The gRPC side exposes the
+filer.proto contract (lookup/list/create/update/delete/rename/subscribe)
+for programmatic clients (mount, S3 gateway, replication).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+from .. import pb
+from ..filer import Filer, FilerError
+from ..filer.entry import Attr, Entry, FileChunk, normalize_path
+from ..filer.filechunks import total_size
+from ..filer.stores import MemoryStore, SqliteStore
+from ..pb import filer_pb2
+from ..util import glog
+from ..util.stats import Metrics
+from .master import _grpc_port
+from .wdclient import MasterClient
+
+
+class FilerServer:
+    def __init__(self, filer: Filer, ip: str = "127.0.0.1",
+                 port: int = 8888, master_url: str = "",
+                 collection: str = "", replication: str = ""):
+        self.filer = filer
+        self.ip = ip
+        self.port = port
+        self.url = f"{ip}:{port}"
+        self.master_url = master_url
+        self.collection = collection
+        self.replication = replication
+        self.master = MasterClient(master_url) if master_url else None
+        self.metrics = Metrics(namespace="filer")
+        self._grpc_server = None
+        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._threads: list[threading.Thread] = []
+
+    # ------------- lifecycle -------------
+
+    def start(self) -> "FilerServer":
+        import grpc
+
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16))
+        self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
+            pb.FILER_SERVICE, pb.FILER_METHODS, _FilerServicer(self)),))
+        bound = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{_grpc_port(self.port)}")
+        if bound == 0:
+            raise RuntimeError(
+                f"cannot bind filer grpc port {_grpc_port(self.port)}")
+        self._grpc_server.start()
+
+        handler = _make_http_handler(self)
+        self._http_server = ThreadingHTTPServer((self.ip, self.port),
+                                                handler)
+        t = threading.Thread(target=self._http_server.serve_forever,
+                             daemon=True, name=f"filer-http-{self.port}")
+        t.start()
+        self._threads.append(t)
+        glog.info("filer started at %s (grpc %d)", self.url,
+                  _grpc_port(self.port))
+        return self
+
+    def stop(self) -> None:
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5).wait(timeout=2)
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self.master:
+            self.master.close()
+        self.filer.store.close()
+
+    def __enter__(self) -> "FilerServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ------------- pb <-> model conversion -------------
+
+def entry_to_pb(e: Entry) -> filer_pb2.Entry:
+    out = filer_pb2.Entry(
+        name=e.name, is_directory=e.is_dir,
+        attributes=filer_pb2.FuseAttributes(
+            file_size=total_size(e.chunks), mtime=int(e.attr.mtime),
+            file_mode=e.attr.mode, uid=e.attr.uid, gid=e.attr.gid,
+            crtime=int(e.attr.crtime), mime=e.attr.mime,
+            replication=e.attr.replication, collection=e.attr.collection,
+            ttl_sec=e.attr.ttl_sec))
+    for c in e.chunks:
+        out.chunks.add(file_id=c.file_id, offset=c.offset, size=c.size,
+                       mtime_ns=c.mtime_ns, etag=c.etag)
+    for k, v in e.extended.items():
+        out.extended[k] = v.encode() if isinstance(v, str) else v
+    return out
+
+
+def pb_to_entry(directory: str, p: filer_pb2.Entry) -> Entry:
+    a = p.attributes
+    return Entry(
+        path=normalize_path(f"{directory}/{p.name}"),
+        attr=Attr(mtime=float(a.mtime or 0), crtime=float(a.crtime or 0),
+                  mode=a.file_mode or 0o660, uid=a.uid, gid=a.gid,
+                  mime=a.mime, ttl_sec=a.ttl_sec,
+                  collection=a.collection, replication=a.replication,
+                  is_dir=p.is_directory),
+        chunks=[FileChunk(file_id=c.file_id, offset=c.offset,
+                          size=c.size, mtime_ns=c.mtime_ns, etag=c.etag)
+                for c in p.chunks],
+        extended={k: v.decode("utf-8", "replace")
+                  for k, v in p.extended.items()})
+
+
+class _FilerServicer:
+    """filer.proto handlers, 1:1 with filer_grpc_server.go."""
+
+    def __init__(self, fs: FilerServer):
+        self.fs = fs
+
+    def LookupDirectoryEntry(self, request, context):
+        e = self.fs.filer.find_entry(
+            f"{request.directory}/{request.name}")
+        resp = filer_pb2.LookupDirectoryEntryResponse()
+        if e is not None:
+            resp.entry.CopyFrom(entry_to_pb(e))
+        return resp
+
+    def ListEntries(self, request, context):
+        limit = request.limit or (1 << 30)
+        start = request.start_from_file_name
+        if request.inclusive_start_from and start:
+            e = self.fs.filer.find_entry(f"{request.directory}/{start}")
+            if e is not None and (not request.prefix
+                                  or e.name.startswith(request.prefix)):
+                yield filer_pb2.ListEntriesResponse(entry=entry_to_pb(e))
+                limit -= 1
+        count = 0
+        for e in self.fs.filer.list_entries(request.directory, start):
+            if count >= limit:
+                break
+            if request.prefix and not e.name.startswith(request.prefix):
+                continue
+            yield filer_pb2.ListEntriesResponse(entry=entry_to_pb(e))
+            count += 1
+
+    def CreateEntry(self, request, context):
+        resp = filer_pb2.CreateEntryResponse()
+        try:
+            self.fs.filer.create_entry(
+                pb_to_entry(request.directory, request.entry),
+                o_excl=request.o_excl)
+        except FilerError as e:
+            resp.error = str(e)
+        return resp
+
+    def UpdateEntry(self, request, context):
+        self.fs.filer.update_entry(
+            pb_to_entry(request.directory, request.entry))
+        return filer_pb2.UpdateEntryResponse()
+
+    def DeleteEntry(self, request, context):
+        resp = filer_pb2.DeleteEntryResponse()
+        path = f"{request.directory}/{request.name}"
+        try:
+            if request.is_delete_data and self.fs.master is not None:
+                self.fs.filer.delete_file_and_chunks(
+                    path, self.fs.master,
+                    recursive=request.is_recursive)
+            else:
+                self.fs.filer.delete_entry(
+                    path, recursive=request.is_recursive)
+        except FilerError as e:
+            resp.error = str(e)
+        return resp
+
+    def AtomicRenameEntry(self, request, context):
+        self.fs.filer.rename(
+            f"{request.old_directory}/{request.old_name}",
+            f"{request.new_directory}/{request.new_name}")
+        return filer_pb2.AtomicRenameEntryResponse()
+
+    def SubscribeMetadata(self, request, context):
+        stop = threading.Event()
+        # Fires when the client cancels or the server shuts down; without
+        # it a cancelled stream would park this executor thread in the
+        # subscribe wait-loop forever and block process exit.
+        context.add_callback(stop.set)
+        prefix = request.path_prefix or "/"
+        for ev in self.fs.filer.subscribe(stop):
+            if not context.is_active():
+                stop.set()
+                return
+            want = "/" if prefix == "/" else normalize_path(prefix) + "/"
+            if not (ev.directory + "/").startswith(want):
+                continue
+            note = filer_pb2.EventNotification(
+                delete_chunks=ev.new_entry is None)
+            if ev.old_entry is not None:
+                note.old_entry.CopyFrom(entry_to_pb(ev.old_entry))
+            if ev.new_entry is not None:
+                note.new_entry.CopyFrom(entry_to_pb(ev.new_entry))
+            yield filer_pb2.SubscribeMetadataResponse(
+                directory=ev.directory, event_notification=note,
+                ts_ns=ev.ts_ns)
+
+
+# ------------- HTTP -------------
+
+def _make_http_handler(fs: FilerServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "seaweedfs-tpu-filer"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, "filer http: " + fmt, *args)
+
+        def _path(self) -> tuple[str, dict]:
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            return normalize_path(unquote(u.path)), q
+
+        def _send(self, code: int, body: bytes = b"",
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _err(self, code: int, msg: str) -> None:
+            self._send(code, json.dumps({"error": msg}).encode())
+
+        def do_GET(self):
+            path, q = self._path()
+            fs.metrics.counter("request_total", method="GET").inc()
+            entry = fs.filer.find_entry(path)
+            if entry is None:
+                self._err(404, f"{path} not found")
+                return
+            if entry.is_dir:
+                limit = int(q.get("limit", "10000"))
+                last = q.get("lastFileName", "")
+                items = [e.to_dict() for e in
+                         fs.filer.list_entries(path, last, limit)]
+                self._send(200, json.dumps(
+                    {"path": path, "entries": items,
+                     "lastFileName":
+                         items[-1]["path"].rsplit("/", 1)[-1]
+                         if items else ""}).encode())
+                return
+            if fs.master is None:
+                self._err(500, "filer has no master connection")
+                return
+            size = total_size(entry.chunks)
+            offset, length = 0, size
+            rng = _parse_range(self.headers.get("Range"), size)
+            if rng is not None:
+                offset, length = rng
+            data = fs.filer.read_file(path, fs.master, offset, length)
+            ctype = entry.attr.mime or "application/octet-stream"
+            self.send_response(206 if rng is not None else 200)
+            if rng is not None:
+                self.send_header(
+                    "Content-Range",
+                    f"bytes {offset}-{offset + len(data) - 1}/{size}")
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_HEAD(self):
+            path, _ = self._path()
+            entry = fs.filer.find_entry(path)
+            if entry is None:
+                self._send(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length",
+                             str(total_size(entry.chunks)))
+            if entry.attr.mime:
+                self.send_header("Content-Type", entry.attr.mime)
+            self.end_headers()
+
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n) if n else b""
+
+        def do_PUT(self):
+            self._upload()
+
+        def do_POST(self):
+            self._upload()
+
+        def _upload(self):
+            path, q = self._path()
+            fs.metrics.counter("request_total", method="PUT").inc()
+            if q.get("mkdir") == "true" or self.path.rstrip("?").endswith(
+                    "/") and not self._body_expected():
+                fs.filer.create_entry(Entry(
+                    path=path, attr=Attr(is_dir=True, mode=0o770)))
+                self._send(201, b"{}")
+                return
+            if fs.master is None:
+                self._err(500, "filer has no master connection")
+                return
+            body = self._read_body()
+            ctype = self.headers.get("Content-Type", "")
+            raw_dir_target = urlparse(self.path).path.endswith("/")
+            if ctype.startswith("multipart/form-data"):
+                body, fname = _first_multipart_file(body, ctype)
+                if fname and raw_dir_target:
+                    # normalize_path stripped the trailing slash; the raw
+                    # URL says "store INTO this directory".
+                    path = normalize_path(path + "/" + fname)
+            try:
+                entry = fs.filer.write_file(
+                    path, body, fs.master,
+                    collection=q.get("collection", fs.collection),
+                    replication=q.get("replication", fs.replication),
+                    mime=ctype if not ctype.startswith(
+                        "multipart/") else "",
+                    chunk_size=int(q["maxMB"]) * 1024 * 1024
+                    if "maxMB" in q else None)
+            except FilerError as e:
+                self._err(409, str(e))
+                return
+            self._send(201, json.dumps(
+                {"name": entry.name,
+                 "size": total_size(entry.chunks)}).encode())
+
+        def _body_expected(self) -> bool:
+            return int(self.headers.get("Content-Length", "0")) > 0
+
+        def do_DELETE(self):
+            path, q = self._path()
+            fs.metrics.counter("request_total", method="DELETE").inc()
+            recursive = q.get("recursive") == "true"
+            try:
+                if fs.master is not None:
+                    fs.filer.delete_file_and_chunks(path, fs.master,
+                                                    recursive=recursive)
+                else:
+                    fs.filer.delete_entry(path, recursive=recursive)
+            except FilerError as e:
+                self._err(404 if "not found" in str(e) else 409, str(e))
+                return
+            self._send(204)
+
+    return Handler
+
+
+def _parse_range(header, size: int):
+    """RFC 7233 single-range parse: (offset, length) or None to serve the
+    full body with 200 (unknown units and malformed values are ignored,
+    suffix ranges bytes=-N mean the LAST N bytes)."""
+    if not header or not header.startswith("bytes="):
+        return None
+    spec = header[6:].split(",")[0].strip()
+    lo, sep, hi = spec.partition("-")
+    if not sep:
+        return None
+    try:
+        if not lo:  # suffix: last N bytes
+            n = int(hi)
+            if n <= 0:
+                return None
+            offset = max(0, size - n)
+            return offset, size - offset
+        offset = int(lo)
+        stop = int(hi) + 1 if hi else size
+    except ValueError:
+        return None
+    if offset >= size:
+        return None
+    return offset, max(0, min(stop, size) - offset)
+
+
+def _first_multipart_file(body: bytes, ctype: str) -> tuple[bytes, str]:
+    """Minimal multipart/form-data parse: first file part's bytes+name."""
+    import email.parser
+    import email.policy
+
+    msg = email.parser.BytesParser(policy=email.policy.default).parsebytes(
+        b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + body)
+    for part in msg.iter_parts():
+        payload = part.get_payload(decode=True)
+        if payload is not None:
+            return payload, part.get_filename() or ""
+    return b"", ""
+
+
+def main(argv: list[str]) -> int:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(prog="filer")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-db", default="",
+                   help="sqlite metadata path (default: in-memory)")
+    args = p.parse_args(argv)
+    store = SqliteStore(args.db) if args.db else MemoryStore()
+    server = FilerServer(Filer(store), ip=args.ip, port=args.port,
+                         master_url=args.master,
+                         collection=args.collection,
+                         replication=args.replication)
+    server.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0

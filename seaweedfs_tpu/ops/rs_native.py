@@ -103,8 +103,9 @@ def _apply_2d(lib, coefs: np.ndarray, x: np.ndarray, out: np.ndarray,
         lib.rs_apply(cp, n_out, n_in, _ptr(x), s, _ptr(out), s, s)
         return
     global _pool
-    if _pool is None:
-        _pool = ThreadPoolExecutor(max_workers=8)
+    with _lib_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=8)
     n_chunks = min(threads, -(-s // THREAD_CHUNK))
     bounds = [s * i // n_chunks for i in range(n_chunks + 1)]
     futs = []

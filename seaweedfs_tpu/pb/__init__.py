@@ -14,7 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable
 
-from . import master_pb2, volume_server_pb2  # noqa: F401
+from . import filer_pb2, master_pb2, volume_server_pb2  # noqa: F401
 
 UNARY = "unary"
 SERVER_STREAM = "server_stream"
@@ -93,6 +93,27 @@ VOLUME_METHODS = [
 ]
 
 
+#: /filer_pb.SeaweedFiler/... method table (matches filer.proto).
+FILER_SERVICE = "filer_pb.SeaweedFiler"
+FILER_METHODS = [
+    Method("LookupDirectoryEntry",
+           filer_pb2.LookupDirectoryEntryRequest,
+           filer_pb2.LookupDirectoryEntryResponse),
+    Method("ListEntries", filer_pb2.ListEntriesRequest,
+           filer_pb2.ListEntriesResponse, SERVER_STREAM),
+    Method("CreateEntry", filer_pb2.CreateEntryRequest,
+           filer_pb2.CreateEntryResponse),
+    Method("UpdateEntry", filer_pb2.UpdateEntryRequest,
+           filer_pb2.UpdateEntryResponse),
+    Method("DeleteEntry", filer_pb2.DeleteEntryRequest,
+           filer_pb2.DeleteEntryResponse),
+    Method("AtomicRenameEntry", filer_pb2.AtomicRenameEntryRequest,
+           filer_pb2.AtomicRenameEntryResponse),
+    Method("SubscribeMetadata", filer_pb2.SubscribeMetadataRequest,
+           filer_pb2.SubscribeMetadataResponse, SERVER_STREAM),
+]
+
+
 def generic_handler(service_name: str, methods: list[Method],
                     servicer) -> "grpc.GenericRpcHandler":
     """Build the server-side dispatch table for one service.
@@ -151,3 +172,7 @@ def master_stub(channel) -> Stub:
 
 def volume_stub(channel) -> Stub:
     return Stub(channel, VOLUME_SERVICE, VOLUME_METHODS)
+
+
+def filer_stub(channel) -> Stub:
+    return Stub(channel, FILER_SERVICE, FILER_METHODS)

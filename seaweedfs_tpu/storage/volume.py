@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
@@ -52,6 +53,10 @@ class Volume:
         self.nm = CompactMap()
         self._dat = None
         self._idx = None
+        # Appends mutate shared file-handle state; reads use os.pread on
+        # the raw fd, so only writers serialize (volume server threads
+        # hit one Volume concurrently).
+        self._lock = threading.RLock()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -98,18 +103,23 @@ class Volume:
         Volume.writeNeedle: append to .dat, then journal to .idx."""
         if self._dat is None:
             raise VolumeError("volume not open")
-        self._dat.seek(0, 2)
-        offset = self._dat.tell()
-        if offset % NEEDLE_PADDING_SIZE:
-            pad = (-offset) % NEEDLE_PADDING_SIZE
-            self._dat.write(b"\x00" * pad)
-            offset += pad
-        rec = n.to_bytes(self.super_block.version)
-        body_size = needle_mod.parse_header(rec)[2]
-        self._dat.write(rec)
-        units = to_offset_units(offset)
-        self.nm.set(n.id, units, body_size)
-        self._idx.write(IndexEntry(n.id, units, body_size).to_bytes())
+        with self._lock:
+            self._dat.seek(0, 2)
+            offset = self._dat.tell()
+            if offset % NEEDLE_PADDING_SIZE:
+                pad = (-offset) % NEEDLE_PADDING_SIZE
+                self._dat.write(b"\x00" * pad)
+                offset += pad
+            rec = n.to_bytes(self.super_block.version)
+            body_size = needle_mod.parse_header(rec)[2]
+            self._dat.write(rec)
+            # Flush to the OS so concurrent pread()s see the record the
+            # moment the index entry is visible.
+            self._dat.flush()
+            units = to_offset_units(offset)
+            self._idx.write(IndexEntry(n.id, units, body_size).to_bytes())
+            self._idx.flush()
+            self.nm.set(n.id, units, body_size)
         return offset
 
     def read_needle(self, key: int, cookie: Optional[int] = None
@@ -119,9 +129,10 @@ class Volume:
             raise KeyError(f"needle {key} not found")
         if self._dat is None:
             raise VolumeError("volume not open")
-        self._dat.seek(entry.byte_offset)
-        rec = self._dat.read(
-            needle_mod.record_size(entry.size, self.super_block.version))
+        rec = os.pread(
+            self._dat.fileno(),
+            needle_mod.record_size(entry.size, self.super_block.version),
+            entry.byte_offset)
         n = needle_mod.Needle.parse(rec, self.super_block.version)
         if n.id != key:
             raise VolumeError(
@@ -131,22 +142,26 @@ class Volume:
         return n
 
     def delete_needle(self, key: int) -> bool:
-        if not self.nm.delete(key):
-            return False
-        self._idx.write(
-            IndexEntry(key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
+        with self._lock:
+            if not self.nm.delete(key):
+                return False
+            self._idx.write(
+                IndexEntry(key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
+            self._idx.flush()
         return True
 
     def sync(self) -> None:
-        for f in (self._dat, self._idx):
-            if f is not None:
-                f.flush()
-                os.fsync(f.fileno())
+        with self._lock:
+            for f in (self._dat, self._idx):
+                if f is not None:
+                    f.flush()
+                    os.fsync(f.fileno())
 
     @property
     def dat_size(self) -> int:
-        self._dat.seek(0, 2)
-        return self._dat.tell()
+        with self._lock:
+            self._dat.seek(0, 2)
+            return self._dat.tell()
 
     def content_size(self) -> int:
         return self.dat_size

@@ -1,0 +1,75 @@
+"""upload/download/delete/benchmark CLI tools against a live cluster."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import cli_tools
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=3).start()
+    store = Store([tmp_path_factory.mktemp("clivol")], max_volumes=8)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_upload_download_delete(cluster, tmp_path, capsys):
+    master, _ = cluster
+    src = tmp_path / "hello.txt"
+    src.write_bytes(b"hello, volume world")
+    assert cli_tools.run_upload(
+        ["-master", master.url, str(src)]) == 0
+    fid = json.loads(capsys.readouterr().out)[0]["fid"]
+
+    outdir = tmp_path / "dl"
+    outdir.mkdir()
+    assert cli_tools.run_download(
+        ["-master", master.url, "-dir", str(outdir), fid]) == 0
+    got = (outdir / fid.replace(",", "_")).read_bytes()
+    assert got == b"hello, volume world"
+
+    assert cli_tools.run_delete(["-master", master.url, fid]) == 0
+    with pytest.raises(Exception):
+        cli_tools.run_download(
+            ["-master", master.url, "-dir", str(outdir), fid])
+
+
+def test_benchmark_smoke(cluster, capsys):
+    master, _ = cluster
+    assert cli_tools.run_benchmark(
+        ["-master", master.url, "-n", "20", "-c", "4",
+         "-size", "512"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["written"] == 20

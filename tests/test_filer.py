@@ -1,0 +1,137 @@
+"""Filer layer: stores, chunk intervals, namespace ops, meta-log."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer import (Attr, Entry, FileChunk, Filer, FilerError,
+                                 MemoryStore, SqliteStore)
+from seaweedfs_tpu.filer.filechunks import (read_plan, total_size,
+                                            visible_intervals)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        return MemoryStore()
+    return SqliteStore(str(tmp_path / "filer.db"))
+
+
+def _chunk(fid, off, size, mtime=0):
+    return FileChunk(file_id=fid, offset=off, size=size, mtime_ns=mtime)
+
+
+class TestFileChunks:
+    def test_disjoint(self):
+        vis = visible_intervals([_chunk("a", 0, 10), _chunk("b", 10, 5)])
+        assert [(v.start, v.stop, v.file_id) for v in vis] == \
+            [(0, 10, "a"), (10, 15, "b")]
+        assert total_size([_chunk("a", 0, 10), _chunk("b", 10, 5)]) == 15
+
+    def test_newer_overwrites_middle(self):
+        vis = visible_intervals([_chunk("old", 0, 100, mtime=1),
+                                 _chunk("new", 30, 20, mtime=2)])
+        assert [(v.start, v.stop, v.file_id, v.chunk_offset)
+                for v in vis] == [(0, 30, "old", 0), (30, 50, "new", 0),
+                                  (50, 100, "old", 50)]
+
+    def test_mtime_order_beats_list_order(self):
+        vis = visible_intervals([_chunk("late", 0, 10, mtime=9),
+                                 _chunk("early", 0, 10, mtime=1)])
+        assert [(v.file_id,) for v in vis] == [("late",)]
+
+    def test_read_plan_with_gap(self):
+        chunks = [_chunk("a", 0, 10), _chunk("b", 20, 10)]
+        plan = read_plan(chunks, 5, 20)
+        assert [(p.file_id, p.chunk_offset, p.length, p.buffer_offset)
+                for p in plan] == [("a", 5, 5, 0), ("b", 0, 5, 15)]
+
+
+class TestNamespace:
+    def test_create_find_list(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(path="/a/b/c.txt",
+                             chunks=[_chunk("1,ab", 0, 3)]))
+        # parents auto-created
+        assert f.find_entry("/a").is_dir
+        assert f.find_entry("/a/b").is_dir
+        e = f.find_entry("/a/b/c.txt")
+        assert e.chunks[0].file_id == "1,ab"
+        names = [x.name for x in f.list_entries("/a/b")]
+        assert names == ["c.txt"]
+
+    def test_o_excl_and_type_conflicts(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(path="/x", attr=Attr(is_dir=False)))
+        with pytest.raises(FilerError):
+            f.create_entry(Entry(path="/x"), o_excl=True)
+        with pytest.raises(FilerError):
+            f.create_entry(Entry(path="/x/y"))  # /x is not a directory
+
+    def test_delete_recursive_returns_orphans(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(path="/d/f1", chunks=[_chunk("1,a", 0, 4)]))
+        f.create_entry(Entry(path="/d/sub/f2",
+                             chunks=[_chunk("2,b", 0, 4)]))
+        with pytest.raises(FilerError):
+            f.delete_entry("/d")  # not empty
+        orphans = f.delete_entry("/d", recursive=True)
+        assert {c.file_id for c in orphans} == {"1,a", "2,b"}
+        assert f.find_entry("/d") is None
+        assert f.find_entry("/d/sub/f2") is None
+
+    def test_rename_moves_subtree(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(path="/src/a", chunks=[_chunk("1,a", 0, 1)]))
+        f.create_entry(Entry(path="/src/deep/b",
+                             chunks=[_chunk("2,b", 0, 1)]))
+        f.rename("/src", "/dst")
+        assert f.find_entry("/src") is None
+        assert f.find_entry("/dst/a").chunks[0].file_id == "1,a"
+        assert f.find_entry("/dst/deep/b").chunks[0].file_id == "2,b"
+
+    def test_listing_order_and_pagination(self, store):
+        f = Filer(store)
+        for name in ("c", "a", "b", "d"):
+            f.create_entry(Entry(path=f"/p/{name}"))
+        assert [e.name for e in f.list_entries("/p")] == \
+            ["a", "b", "c", "d"]
+        assert [e.name for e in f.list_entries("/p", start_name="b",
+                                               limit=2)] == ["c", "d"]
+
+    def test_sqlite_survives_reopen(self, tmp_path):
+        db = str(tmp_path / "f.db")
+        f = Filer(SqliteStore(db))
+        f.create_entry(Entry(path="/keep/me",
+                             chunks=[_chunk("9,z", 0, 7)]))
+        f.store.close()
+        f2 = Filer(SqliteStore(db))
+        assert f2.find_entry("/keep/me").chunks[0].size == 7
+
+
+class TestMetaLog:
+    def test_subscribe_sees_mutations(self):
+        f = Filer()
+        events = []
+        stop = threading.Event()
+        ready = threading.Event()
+
+        def consume():
+            ready.set()
+            for ev in f.subscribe(stop):
+                events.append(ev)
+                if len(events) >= 2:
+                    stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        ready.wait(1)
+        f.create_entry(Entry(path="/n1"))
+        f.delete_entry("/n1")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert events[0].new_entry.path == "/n1"
+        assert events[0].old_entry is None
+        assert events[1].new_entry is None
+        assert events[1].old_entry.path == "/n1"

@@ -1,0 +1,211 @@
+"""Filer server end-to-end over a live localhost cluster (HTTP + gRPC)."""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer, _grpc_port
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu import pb
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=7).start()
+    stores = []
+    servers = []
+    for i in range(2):
+        d = tmp_path_factory.mktemp(f"fvol{i}")
+        store = Store([d], max_volumes=8)
+        stores.append(store)
+        servers.append(VolumeServer(store, port=_free_port_pair(),
+                                    master_url=master.url,
+                                    pulse_seconds=PULSE).start())
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 2:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    yield master, servers, filer
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _url(filer: FilerServer, path: str) -> str:
+    return f"http://{filer.url}{path}"
+
+
+def _put(filer, path, data: bytes, query: str = ""):
+    req = urllib.request.Request(_url(filer, path) + query, data=data,
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get(filer, path, headers=None) -> bytes:
+    req = urllib.request.Request(_url(filer, path),
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.read()
+
+
+def test_put_get_roundtrip_chunked(stack):
+    _, _, filer = stack
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, 3 * 1024 * 1024 + 17,
+                           dtype=np.uint8).tobytes()
+    # maxMB=1 forces 4 chunks through assign/upload
+    resp = _put(filer, "/docs/big.bin", payload, "?maxMB=1")
+    assert resp["size"] == len(payload)
+    entry = filer.filer.find_entry("/docs/big.bin")
+    assert len(entry.chunks) == 4
+    assert _get(filer, "/docs/big.bin") == payload
+
+
+def test_range_read(stack):
+    _, _, filer = stack
+    payload = bytes(range(256)) * 1024
+    _put(filer, "/docs/range.bin", payload)
+    got = _get(filer, "/docs/range.bin",
+               {"Range": "bytes=1000-1999"})
+    assert got == payload[1000:2000]
+
+
+def test_suffix_and_bad_ranges(stack):
+    _, _, filer = stack
+    payload = bytes(range(256)) * 64
+    _put(filer, "/docs/suffix.bin", payload)
+    got = _get(filer, "/docs/suffix.bin", {"Range": "bytes=-100"})
+    assert got == payload[-100:]
+    # unknown unit / malformed -> full body with 200
+    for bad in ("items=0-10", "bytes=abc-", "bytes=5"):
+        req = urllib.request.Request(_url(filer, "/docs/suffix.bin"),
+                                     headers={"Range": bad})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.read() == payload
+
+
+def test_multipart_upload_into_directory(stack):
+    _, _, filer = stack
+    boundary = "x123"
+    body = (f"--{boundary}\r\n"
+            "Content-Disposition: form-data; name=\"file\"; "
+            "filename=\"pic.bin\"\r\n"
+            "Content-Type: application/octet-stream\r\n\r\n").encode() \
+        + b"PAYLOAD" + f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        _url(filer, "/gallery/"), data=body, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 201
+    assert _get(filer, "/gallery/pic.bin") == b"PAYLOAD"
+
+
+def test_directory_listing_json(stack):
+    _, _, filer = stack
+    _put(filer, "/list/a.txt", b"a")
+    _put(filer, "/list/b.txt", b"bb")
+    body = json.loads(_get(filer, "/list"))
+    names = [e["path"].rsplit("/", 1)[-1] for e in body["entries"]]
+    assert names == ["a.txt", "b.txt"]
+
+
+def test_delete_reclaims_and_404s(stack):
+    _, _, filer = stack
+    _put(filer, "/del/x.bin", b"x" * 1024)
+    req = urllib.request.Request(_url(filer, "/del/x.bin"),
+                                 method="DELETE")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(filer, "/del/x.bin")
+    assert ei.value.code == 404
+
+
+def test_grpc_surface(stack):
+    import grpc
+
+    _, _, filer = stack
+    ch = grpc.insecure_channel(
+        f"127.0.0.1:{_grpc_port(filer.port)}")
+    stub = pb.filer_stub(ch)
+    # CreateEntry + Lookup
+    stub.CreateEntry(filer_pb2.CreateEntryRequest(
+        directory="/grpc", entry=filer_pb2.Entry(
+            name="hello.txt",
+            attributes=filer_pb2.FuseAttributes(file_mode=0o640),
+            chunks=[filer_pb2.FileChunk(file_id="1,ff", offset=0,
+                                        size=5)])))
+    resp = stub.LookupDirectoryEntry(
+        filer_pb2.LookupDirectoryEntryRequest(directory="/grpc",
+                                              name="hello.txt"))
+    assert resp.entry.name == "hello.txt"
+    assert resp.entry.chunks[0].file_id == "1,ff"
+    # ListEntries stream
+    names = [r.entry.name for r in stub.ListEntries(
+        filer_pb2.ListEntriesRequest(directory="/grpc"))]
+    assert names == ["hello.txt"]
+    # Rename + Delete
+    stub.AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+        old_directory="/grpc", old_name="hello.txt",
+        new_directory="/grpc", new_name="renamed.txt"))
+    resp = stub.LookupDirectoryEntry(
+        filer_pb2.LookupDirectoryEntryRequest(directory="/grpc",
+                                              name="renamed.txt"))
+    assert resp.entry.name == "renamed.txt"
+    stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+        directory="/grpc", name="renamed.txt"))
+    resp = stub.LookupDirectoryEntry(
+        filer_pb2.LookupDirectoryEntryRequest(directory="/grpc",
+                                              name="renamed.txt"))
+    assert not resp.entry.name
+    ch.close()
+
+
+def test_subscribe_metadata_stream(stack):
+    import grpc
+
+    _, _, filer = stack
+    ch = grpc.insecure_channel(
+        f"127.0.0.1:{_grpc_port(filer.port)}")
+    stub = pb.filer_stub(ch)
+    stream = stub.SubscribeMetadata(
+        filer_pb2.SubscribeMetadataRequest(client_name="t"))
+    time.sleep(0.2)  # let the server register the subscriber
+    _put(filer, "/sub/notify.txt", b"hi")
+    ev = next(iter(stream))
+    assert ev.event_notification.new_entry.name in ("sub", "notify.txt")
+    stream.cancel()
+    ch.close()
