@@ -65,6 +65,16 @@ def _run_benchmark(argv: list[str]) -> int:
     return run_benchmark(argv)
 
 
+def _run_s3(argv: list[str]) -> int:
+    from .gateway.s3 import main
+    return main(argv)
+
+
+def _run_webdav(argv: list[str]) -> int:
+    from .gateway.webdav import main
+    return main(argv)
+
+
 COMMANDS = {
     "shell": _run_shell,
     "master": _run_master,
@@ -74,6 +84,8 @@ COMMANDS = {
     "download": _run_download,
     "delete": _run_delete,
     "benchmark": _run_benchmark,
+    "s3": _run_s3,
+    "webdav": _run_webdav,
     "scaffold": _run_scaffold,
 }
 

@@ -1,0 +1,137 @@
+"""Client for a filer: gRPC for metadata, HTTP for chunked data.
+
+The reference's gateways (S3, WebDAV, mount) all sit on filer.proto plus
+the filer HTTP data path (SURVEY.md §2 "S3 gateway", "FUSE mount");
+this is that access layer: entry CRUD over the filer gRPC service and
+read/write of file bytes through the filer's HTTP API so the gateway
+never re-implements chunking.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterator, Optional
+
+from .. import pb
+from ..pb import filer_pb2
+from .master import _grpc_port
+
+
+class FilerClientError(RuntimeError):
+    pass
+
+
+class FilerClient:
+    def __init__(self, filer_url: str):
+        """``filer_url`` is the HTTP host:port; gRPC uses the port twin."""
+        self.filer_url = filer_url
+        self._lock = threading.Lock()
+        self._channel = None
+
+    def _stub(self) -> pb.Stub:
+        import grpc
+
+        with self._lock:
+            if self._channel is None:
+                ip, http_port = self.filer_url.rsplit(":", 1)
+                self._channel = grpc.insecure_channel(
+                    f"{ip}:{_grpc_port(int(http_port))}")
+            return pb.filer_stub(self._channel)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+
+    # ---- metadata (gRPC) ----
+
+    def lookup(self, directory: str, name: str
+               ) -> Optional[filer_pb2.Entry]:
+        resp = self._stub().LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(directory=directory,
+                                                  name=name))
+        return resp.entry if resp.entry.name else None
+
+    def list(self, directory: str, prefix: str = "",
+             start_from: str = "", limit: int = 0,
+             inclusive: bool = False) -> Iterator[filer_pb2.Entry]:
+        for r in self._stub().ListEntries(filer_pb2.ListEntriesRequest(
+                directory=directory, prefix=prefix,
+                start_from_file_name=start_from,
+                inclusive_start_from=inclusive, limit=limit)):
+            yield r.entry
+
+    def create(self, directory: str, entry: filer_pb2.Entry,
+               o_excl: bool = False) -> None:
+        resp = self._stub().CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=directory, entry=entry, o_excl=o_excl))
+        if resp.error:
+            raise FilerClientError(resp.error)
+
+    def mkdir(self, directory: str, name: str) -> None:
+        self.create(directory, filer_pb2.Entry(
+            name=name, is_directory=True,
+            attributes=filer_pb2.FuseAttributes(file_mode=0o770)))
+
+    def delete(self, directory: str, name: str, recursive: bool = False,
+               delete_data: bool = True) -> None:
+        resp = self._stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
+            directory=directory, name=name, is_recursive=recursive,
+            is_delete_data=delete_data))
+        if resp.error:
+            raise FilerClientError(resp.error)
+
+    def rename(self, old_dir: str, old_name: str, new_dir: str,
+               new_name: str) -> None:
+        self._stub().AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+            old_directory=old_dir, old_name=old_name,
+            new_directory=new_dir, new_name=new_name))
+
+    # ---- data (HTTP) ----
+
+    def _url(self, path: str, query: str = "") -> str:
+        quoted = urllib.parse.quote(path)
+        return f"http://{self.filer_url}{quoted}" + \
+            (f"?{query}" if query else "")
+
+    def put_data(self, path: str, data: bytes, mime: str = "",
+                 query: str = "") -> dict:
+        req = urllib.request.Request(self._url(path, query), data=data,
+                                     method="PUT")
+        if mime:
+            req.add_header("Content-Type", mime)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise FilerClientError(
+                f"PUT {path}: {e.code} {e.read()!r}") from e
+
+    def get_data(self, path: str, offset: int = 0,
+                 length: Optional[int] = None) -> bytes:
+        req = urllib.request.Request(self._url(path))
+        if offset or length is not None:
+            stop = "" if length is None else str(offset + length - 1)
+            req.add_header("Range", f"bytes={offset}-{stop}")
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            raise FilerClientError(
+                f"GET {path}: {e.code}") from e
+
+    def delete_data(self, path: str, recursive: bool = False) -> None:
+        q = "recursive=true" if recursive else ""
+        req = urllib.request.Request(self._url(path, q), method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise FilerClientError(
+                    f"DELETE {path}: {e.code}") from e

@@ -1,0 +1,1 @@
+"""Gateways (L5): S3 REST and WebDAV over the filer (weed/s3api analog)."""

@@ -1,0 +1,563 @@
+"""S3 REST gateway over the filer (weed/s3api analog, SURVEY.md §2).
+
+Buckets are directories under ``/buckets`` on the filer, objects are
+filer entries beneath them — the reference's layout. Supported surface:
+bucket CRUD + listing, object PUT/GET/HEAD/DELETE with ranges,
+CopyObject, ListObjectsV1/V2 (prefix, delimiter, continuation, max-keys)
+and multipart uploads. Multipart "complete" is metadata-only: each
+part's chunk list is re-offset and concatenated into the final entry, so
+terabyte objects assemble without moving a byte — the chunked-entry
+design makes the reference's part-merge copy unnecessary.
+
+Auth: AWS SigV4 (header or presigned) against identities from an
+s3-config JSON; with no identities the gateway is open (reference
+default).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..cluster.filer_client import FilerClient, FilerClientError
+from ..pb import filer_pb2
+from ..util import glog
+from ..util.stats import Metrics
+from .s3_auth import AuthError, Identity, SigV4Verifier
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = ".uploads"
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + \
+        ET.tostring(root)
+
+
+def _error_xml(code: str, message: str, resource: str) -> bytes:
+    e = ET.Element("Error")
+    ET.SubElement(e, "Code").text = code
+    ET.SubElement(e, "Message").text = message
+    ET.SubElement(e, "Resource").text = resource
+    return _xml(e)
+
+
+_STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
+           "BucketAlreadyExists": 409, "BucketNotEmpty": 409,
+           "AccessDenied": 403, "InvalidAccessKeyId": 403,
+           "SignatureDoesNotMatch": 403, "InvalidArgument": 400,
+           "AuthorizationHeaderMalformed": 400,
+           "AuthorizationQueryParametersError": 400,
+           "InvalidPart": 400, "MalformedXML": 400,
+           "InternalError": 500}
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+        self.message = message or code
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+class S3Gateway:
+    def __init__(self, filer_url: str, ip: str = "127.0.0.1",
+                 port: int = 8333,
+                 identities: Optional[list[Identity]] = None):
+        self.filer = FilerClient(filer_url)
+        self.ip = ip
+        self.port = port
+        self.url = f"{ip}:{port}"
+        self.auth = SigV4Verifier(identities)
+        self.metrics = Metrics(namespace="s3")
+        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "S3Gateway":
+        handler = _make_handler(self)
+        self._http_server = ThreadingHTTPServer((self.ip, self.port),
+                                                handler)
+        self._thread = threading.Thread(
+            target=self._http_server.serve_forever, daemon=True,
+            name=f"s3-{self.port}")
+        self._thread.start()
+        glog.info("s3 gateway at %s -> filer %s", self.url,
+                  self.filer.filer_url)
+        return self
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        self.filer.close()
+
+    def __enter__(self) -> "S3Gateway":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- bucket ops ----
+
+    def list_buckets(self) -> bytes:
+        root = ET.Element("ListAllMyBucketsResult", xmlns=XMLNS)
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
+        buckets = ET.SubElement(root, "Buckets")
+        for e in self.filer.list(BUCKETS_DIR):
+            if not e.is_directory or e.name == UPLOADS_DIR:
+                continue
+            b = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(b, "Name").text = e.name
+            ET.SubElement(b, "CreationDate").text = _iso(
+                e.attributes.crtime or e.attributes.mtime)
+        return _xml(root)
+
+    def create_bucket(self, bucket: str) -> None:
+        if self.filer.lookup(BUCKETS_DIR, bucket) is not None:
+            raise S3Error("BucketAlreadyExists", bucket)
+        self.filer.mkdir(BUCKETS_DIR, bucket)
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._require_bucket(bucket)
+        if next(iter(self.filer.list(f"{BUCKETS_DIR}/{bucket}",
+                                     limit=1)), None) is not None:
+            raise S3Error("BucketNotEmpty", bucket)
+        self.filer.delete(BUCKETS_DIR, bucket, recursive=True)
+
+    def _require_bucket(self, bucket: str) -> None:
+        if self.filer.lookup(BUCKETS_DIR, bucket) is None:
+            raise S3Error("NoSuchBucket", bucket)
+
+    # ---- object listing ----
+
+    def list_objects(self, bucket: str, q: dict, v2: bool) -> bytes:
+        self._require_bucket(bucket)
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        if v2:
+            after = q.get("continuation-token") or q.get("start-after",
+                                                         "")
+        else:
+            after = q.get("marker", "")
+        base = f"{BUCKETS_DIR}/{bucket}"
+        contents: list[tuple[str, filer_pb2.Entry]] = []
+        prefixes: list[str] = []
+        truncated = self._walk(base, "", prefix, delimiter, after,
+                               max_keys, contents, prefixes)
+        root = ET.Element(
+            "ListBucketResult", xmlns=XMLNS)
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if truncated else "false"
+        if delimiter:
+            ET.SubElement(root, "Delimiter").text = delimiter
+        if v2:
+            ET.SubElement(root, "KeyCount").text = str(
+                len(contents) + len(prefixes))
+            if truncated and contents:
+                ET.SubElement(root, "NextContinuationToken").text = \
+                    contents[-1][0]
+        elif truncated and contents:
+            ET.SubElement(root, "NextMarker").text = contents[-1][0]
+        for key, e in contents:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "LastModified").text = _iso(
+                e.attributes.mtime)
+            ET.SubElement(c, "ETag").text = f'"{_etag(e)}"'
+            ET.SubElement(c, "Size").text = str(e.attributes.file_size)
+            ET.SubElement(c, "StorageClass").text = "STANDARD"
+        for p in prefixes:
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
+        return _xml(root)
+
+    def _walk(self, base: str, rel: str, prefix: str, delimiter: str,
+              after: str, max_keys: int,
+              contents: list, prefixes: list) -> bool:
+        """DFS in key order; returns True when truncated."""
+        directory = f"{base}/{rel}" if rel else base
+        for e in self.filer.list(directory):
+            key = f"{rel}{e.name}" if not e.is_directory else \
+                f"{rel}{e.name}/"
+            if e.is_directory and e.name == UPLOADS_DIR and not rel:
+                continue
+            probe = key if not e.is_directory else key[:-1]
+            if prefix and not probe.startswith(prefix) \
+                    and not prefix.startswith(key):
+                continue
+            if e.is_directory:
+                if delimiter == "/" and key.startswith(prefix):
+                    if key > after:
+                        prefixes.append(key)
+                    continue
+                if self._walk(base, key, prefix, delimiter, after,
+                              max_keys, contents, prefixes):
+                    return True
+                continue
+            if not key.startswith(prefix) or key <= after:
+                continue
+            if len(contents) + len(prefixes) >= max_keys:
+                return True
+            contents.append((key, e))
+        return False
+
+    # ---- object ops ----
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   mime: str) -> str:
+        self._require_bucket(bucket)
+        self.filer.put_data(f"{BUCKETS_DIR}/{bucket}/{key}", data,
+                            mime=mime)
+        return hashlib.md5(data).hexdigest()
+
+    def get_object_entry(self, bucket: str, key: str) -> filer_pb2.Entry:
+        self._require_bucket(bucket)
+        d, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+        e = self.filer.lookup(d, name)
+        if e is None or e.is_directory:
+            raise S3Error("NoSuchKey", key)
+        return e
+
+    def get_object(self, bucket: str, key: str, offset: int = 0,
+                   length: Optional[int] = None) -> bytes:
+        self.get_object_entry(bucket, key)
+        return self.filer.get_data(f"{BUCKETS_DIR}/{bucket}/{key}",
+                                   offset, length)
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._require_bucket(bucket)
+        d, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+        try:
+            self.filer.delete(d, name, recursive=True)
+        except FilerClientError:
+            pass  # S3 deletes are idempotent
+
+    def copy_object(self, bucket: str, key: str, src_bucket: str,
+                    src_key: str) -> bytes:
+        src = self.get_object_entry(src_bucket, src_key)
+        self._require_bucket(bucket)
+        dst_dir, _, dst_name = \
+            f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+        dup = filer_pb2.Entry()
+        dup.CopyFrom(src)
+        dup.name = dst_name
+        self.filer.create(dst_dir, dup)
+        root = ET.Element("CopyObjectResult", xmlns=XMLNS)
+        ET.SubElement(root, "LastModified").text = _iso(time.time())
+        ET.SubElement(root, "ETag").text = f'"{_etag(src)}"'
+        return _xml(root)
+
+    # ---- multipart ----
+
+    def initiate_multipart(self, bucket: str, key: str) -> bytes:
+        self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        self.filer.mkdir(f"{BUCKETS_DIR}/{UPLOADS_DIR}", upload_id)
+        marker = filer_pb2.Entry(name="key", is_directory=False)
+        marker.extended["key"] = key.encode()
+        marker.extended["bucket"] = bucket.encode()
+        self.filer.create(f"{BUCKETS_DIR}/{UPLOADS_DIR}/{upload_id}",
+                          marker)
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        return _xml(root)
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        self._upload_dir(upload_id)
+        self.filer.put_data(
+            f"{BUCKETS_DIR}/{UPLOADS_DIR}/{upload_id}/"
+            f"{part_number:05d}.part", data)
+        return hashlib.md5(data).hexdigest()
+
+    def _upload_dir(self, upload_id: str) -> str:
+        d = f"{BUCKETS_DIR}/{UPLOADS_DIR}/{upload_id}"
+        if self.filer.lookup(f"{BUCKETS_DIR}/{UPLOADS_DIR}",
+                             upload_id) is None:
+            raise S3Error("NoSuchUpload", upload_id)
+        return d
+
+    def complete_multipart(self, bucket: str, key: str,
+                           upload_id: str) -> bytes:
+        d = self._upload_dir(upload_id)
+        parts = sorted(
+            (e for e in self.filer.list(d)
+             if e.name.endswith(".part")), key=lambda e: e.name)
+        if not parts:
+            raise S3Error("InvalidPart", "no parts uploaded")
+        # Metadata-only assembly: concatenate every part's chunks with
+        # re-based offsets into one entry.
+        final = filer_pb2.Entry(name=key.rsplit("/", 1)[-1],
+                                is_directory=False)
+        offset = 0
+        for p in parts:
+            for c in p.chunks:
+                nc = final.chunks.add()
+                nc.CopyFrom(c)
+                nc.offset = offset + c.offset
+            offset += p.attributes.file_size
+        final.attributes.CopyFrom(parts[0].attributes)
+        final.attributes.file_size = offset
+        final.attributes.mtime = int(time.time())
+        dst_dir = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")[0]
+        self.filer.create(dst_dir, final)
+        # Drop the upload scaffolding WITHOUT deleting chunk data — the
+        # final entry owns those chunks now.
+        self.filer.delete(f"{BUCKETS_DIR}/{UPLOADS_DIR}", upload_id,
+                          recursive=True, delete_data=False)
+        root = ET.Element("CompleteMultipartUploadResult", xmlns=XMLNS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = \
+            f'"{hashlib.md5(str(offset).encode()).hexdigest()}-' \
+            f'{len(parts)}"'
+        return _xml(root)
+
+    def abort_multipart(self, upload_id: str) -> None:
+        self._upload_dir(upload_id)
+        self.filer.delete(f"{BUCKETS_DIR}/{UPLOADS_DIR}", upload_id,
+                          recursive=True, delete_data=True)
+
+
+def _etag(e: filer_pb2.Entry) -> str:
+    if e.extended.get("etag"):
+        return e.extended["etag"].decode()
+    h = hashlib.md5()
+    for c in e.chunks:
+        h.update(c.file_id.encode())
+    return h.hexdigest()
+
+
+def _make_handler(gw: S3Gateway):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "seaweedfs-tpu-s3"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, "s3 http: " + fmt, *args)
+
+        # -- plumbing --
+
+        def _split(self) -> tuple[str, str, dict, str]:
+            u = urllib.parse.urlsplit(self.path)
+            q = {k: v[0] for k, v in urllib.parse.parse_qs(
+                u.query, keep_blank_values=True).items()}
+            parts = urllib.parse.unquote(u.path).lstrip("/").split(
+                "/", 1)
+            bucket = parts[0]
+            key = parts[1] if len(parts) > 1 else ""
+            return bucket, key, q, u.query
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n) if n else b""
+
+        def _send(self, code: int, body: bytes = b"",
+                  ctype: str = "application/xml",
+                  extra: Optional[dict] = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            if not extra or "Content-Length" not in extra:
+                self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _fail(self, exc) -> None:
+            if isinstance(exc, AuthError):
+                code, msg = exc.code, str(exc)
+            elif isinstance(exc, S3Error):
+                code, msg = exc.code, exc.message
+            elif isinstance(exc, FilerClientError):
+                code, msg = "InternalError", str(exc)
+            else:
+                code, msg = "InternalError", str(exc)
+            self._send(_STATUS.get(code, 500),
+                       _error_xml(code, msg, self.path))
+
+        def _auth(self, body: bytes) -> None:
+            u = urllib.parse.urlsplit(self.path)
+            gw.auth.verify(self.command, u.path or "/", u.query,
+                           self.headers,
+                           hashlib.sha256(body).hexdigest())
+
+        # -- verbs --
+
+        def do_GET(self):
+            bucket, key, q, _ = self._split()
+            gw.metrics.counter("request_total", method="GET").inc()
+            try:
+                self._auth(b"")
+                if not bucket:
+                    self._send(200, gw.list_buckets())
+                elif not key:
+                    v2 = q.get("list-type") == "2"
+                    self._send(200, gw.list_objects(bucket, q, v2))
+                else:
+                    entry = gw.get_object_entry(bucket, key)
+                    size = entry.attributes.file_size
+                    rng = self.headers.get("Range")
+                    offset, length = 0, None
+                    status, extra = 200, {}
+                    if rng and rng.startswith("bytes=") and size:
+                        lo, _, hi = rng[6:].partition("-")
+                        if lo:
+                            offset = int(lo)
+                            stop = int(hi) + 1 if hi else size
+                        else:
+                            offset = max(0, size - int(hi))
+                            stop = size
+                        length = max(0, min(stop, size) - offset)
+                        status = 206
+                        extra["Content-Range"] = \
+                            f"bytes {offset}-{offset + length - 1}" \
+                            f"/{size}"
+                    data = gw.get_object(bucket, key, offset, length)
+                    extra["ETag"] = f'"{_etag(entry)}"'
+                    extra["Last-Modified"] = time.strftime(
+                        "%a, %d %b %Y %H:%M:%S GMT",
+                        time.gmtime(entry.attributes.mtime))
+                    self._send(status, data,
+                               entry.attributes.mime
+                               or "application/octet-stream", extra)
+            except Exception as e:
+                self._fail(e)
+
+        def do_HEAD(self):
+            bucket, key, q, _ = self._split()
+            try:
+                self._auth(b"")
+                if not key:
+                    gw._require_bucket(bucket)
+                    self._send(200)
+                    return
+                entry = gw.get_object_entry(bucket, key)
+                self._send(200, b"",
+                           entry.attributes.mime
+                           or "application/octet-stream",
+                           {"Content-Length":
+                            str(entry.attributes.file_size),
+                            "ETag": f'"{_etag(entry)}"'})
+            except Exception as e:
+                self._fail(e)
+
+        def do_PUT(self):
+            bucket, key, q, _ = self._split()
+            gw.metrics.counter("request_total", method="PUT").inc()
+            body = self._body()
+            try:
+                self._auth(body)
+                if not key:
+                    gw.create_bucket(bucket)
+                    self._send(200)
+                elif "partNumber" in q and "uploadId" in q:
+                    etag = gw.upload_part(bucket, key, q["uploadId"],
+                                          int(q["partNumber"]), body)
+                    self._send(200, b"", extra={"ETag": f'"{etag}"'})
+                elif "x-amz-copy-source" in self.headers:
+                    src = urllib.parse.unquote(
+                        self.headers["x-amz-copy-source"]).lstrip("/")
+                    sb, _, sk = src.partition("/")
+                    self._send(200, gw.copy_object(bucket, key, sb, sk))
+                else:
+                    etag = gw.put_object(
+                        bucket, key, body,
+                        self.headers.get("Content-Type", ""))
+                    self._send(200, b"", extra={"ETag": f'"{etag}"'})
+            except Exception as e:
+                self._fail(e)
+
+        def do_POST(self):
+            bucket, key, q, _ = self._split()
+            body = self._body()
+            try:
+                self._auth(body)
+                if "uploads" in q:
+                    self._send(200, gw.initiate_multipart(bucket, key))
+                elif "uploadId" in q:
+                    self._send(200, gw.complete_multipart(
+                        bucket, key, q["uploadId"]))
+                else:
+                    raise S3Error("InvalidArgument",
+                                  "unsupported POST")
+            except Exception as e:
+                self._fail(e)
+
+        def do_DELETE(self):
+            bucket, key, q, _ = self._split()
+            gw.metrics.counter("request_total", method="DELETE").inc()
+            try:
+                self._auth(b"")
+                if "uploadId" in q:
+                    gw.abort_multipart(q["uploadId"])
+                    self._send(204)
+                elif not key:
+                    gw.delete_bucket(bucket)
+                    self._send(204)
+                else:
+                    gw.delete_object(bucket, key)
+                    self._send(204)
+            except Exception as e:
+                self._fail(e)
+
+    return Handler
+
+
+def load_identities(path: str) -> list[Identity]:
+    """s3-config JSON: {"identities": [{"name", "credentials":
+    [{"accessKey", "secretKey"}], "actions": [...]}]} — the reference's
+    s3.json shape."""
+    import json
+
+    with open(path) as f:
+        cfg = json.load(f)
+    out = []
+    for ident in cfg.get("identities", []):
+        for cred in ident.get("credentials", []):
+            out.append(Identity(
+                name=ident.get("name", cred["accessKey"]),
+                access_key=cred["accessKey"],
+                secret_key=cred["secretKey"],
+                actions=tuple(ident.get("actions", ["Admin"]))))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(prog="s3")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-config", default="",
+                   help="identities JSON (empty = open access)")
+    args = p.parse_args(argv)
+    idents = load_identities(args.config) if args.config else None
+    gw = S3Gateway(args.filer, ip=args.ip, port=args.port,
+                   identities=idents).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    gw.stop()
+    return 0

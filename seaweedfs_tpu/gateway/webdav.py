@@ -1,0 +1,281 @@
+"""WebDAV server over the filer (weed/server/webdav_server.go analog).
+
+Class-1 WebDAV on the filer namespace: OPTIONS, PROPFIND (Depth 0/1),
+GET/HEAD, PUT, DELETE, MKCOL, MOVE and COPY. Enough for davfs2 /
+cadaver / OS file-manager mounts, matching the subset the reference's
+golang.org/x/net/webdav handler exposes over its filer FS adapter.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..cluster.filer_client import FilerClient, FilerClientError
+from ..util import glog
+
+DAV_NS = "DAV:"
+
+
+def _rfc1123(ts: float) -> str:
+    import time
+
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+class WebDavServer:
+    def __init__(self, filer_url: str, ip: str = "127.0.0.1",
+                 port: int = 7333, root: str = "/"):
+        self.filer = FilerClient(filer_url)
+        self.ip = ip
+        self.port = port
+        self.url = f"{ip}:{port}"
+        self.root = root.rstrip("/")
+        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "WebDavServer":
+        self._http_server = ThreadingHTTPServer(
+            (self.ip, self.port), _make_handler(self))
+        self._thread = threading.Thread(
+            target=self._http_server.serve_forever, daemon=True,
+            name=f"webdav-{self.port}")
+        self._thread.start()
+        glog.info("webdav at %s -> filer %s", self.url,
+                  self.filer.filer_url)
+        return self
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        self.filer.close()
+
+    def __enter__(self) -> "WebDavServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def fpath(self, dav_path: str) -> str:
+        p = self.root + dav_path
+        return p if p.startswith("/") else "/" + p
+
+
+def _prop_response(href: str, is_dir: bool, size: int, mtime: float
+                   ) -> ET.Element:
+    resp = ET.Element(f"{{{DAV_NS}}}response")
+    ET.SubElement(resp, f"{{{DAV_NS}}}href").text = urllib.parse.quote(
+        href + ("/" if is_dir and not href.endswith("/") else ""))
+    stat = ET.SubElement(resp, f"{{{DAV_NS}}}propstat")
+    prop = ET.SubElement(stat, f"{{{DAV_NS}}}prop")
+    rtype = ET.SubElement(prop, f"{{{DAV_NS}}}resourcetype")
+    if is_dir:
+        ET.SubElement(rtype, f"{{{DAV_NS}}}collection")
+    else:
+        ET.SubElement(prop,
+                      f"{{{DAV_NS}}}getcontentlength").text = str(size)
+    ET.SubElement(prop, f"{{{DAV_NS}}}getlastmodified").text = \
+        _rfc1123(mtime)
+    ET.SubElement(stat, f"{{{DAV_NS}}}status").text = \
+        "HTTP/1.1 200 OK"
+    return resp
+
+
+def _make_handler(dav: WebDavServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "seaweedfs-tpu-webdav"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, "webdav: " + fmt, *args)
+
+        def _send(self, code: int, body: bytes = b"",
+                  ctype: str = "application/xml; charset=utf-8",
+                  extra: Optional[dict] = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _dav_path(self) -> str:
+            p = urllib.parse.unquote(
+                urllib.parse.urlsplit(self.path).path)
+            return p if p == "/" else p.rstrip("/")
+
+        def _lookup(self, path: str):
+            fp = dav.fpath(path)
+            if fp == "/":
+                import time
+
+                from ..pb import filer_pb2
+                e = filer_pb2.Entry(name="", is_directory=True)
+                e.attributes.mtime = int(time.time())
+                return e
+            d, _, name = fp.rpartition("/")
+            return dav.filer.lookup(d or "/", name)
+
+        def do_OPTIONS(self):
+            self._send(200, extra={
+                "DAV": "1",
+                "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, "
+                         "MKCOL, MOVE, COPY"})
+
+        def do_PROPFIND(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            if n:
+                self.rfile.read(n)
+            path = self._dav_path()
+            depth = self.headers.get("Depth", "1")
+            entry = self._lookup(path)
+            if entry is None:
+                self._send(404)
+                return
+            ms = ET.Element(f"{{{DAV_NS}}}multistatus")
+            ms.append(_prop_response(
+                path, entry.is_directory, entry.attributes.file_size,
+                entry.attributes.mtime))
+            if entry.is_directory and depth != "0":
+                base = path if path != "/" else ""
+                for child in dav.filer.list(dav.fpath(path)):
+                    ms.append(_prop_response(
+                        f"{base}/{child.name}", child.is_directory,
+                        child.attributes.file_size,
+                        child.attributes.mtime))
+            self._send(207, ET.tostring(ms))
+
+        def do_GET(self):
+            path = self._dav_path()
+            entry = self._lookup(path)
+            if entry is None:
+                self._send(404)
+                return
+            if entry.is_directory:
+                self._send(403)
+                return
+            try:
+                data = dav.filer.get_data(dav.fpath(path))
+            except FilerClientError:
+                self._send(404)
+                return
+            self._send(200, data, entry.attributes.mime
+                       or "application/octet-stream")
+
+        def do_HEAD(self):
+            path = self._dav_path()
+            entry = self._lookup(path)
+            if entry is None:
+                self._send(404)
+                return
+            self._send(200, b"", "application/octet-stream", {
+                "Content-Length": "0" if entry.is_directory
+                else str(entry.attributes.file_size)})
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(n) if n else b""
+            path = self._dav_path()
+            try:
+                dav.filer.put_data(
+                    dav.fpath(path), body,
+                    mime=self.headers.get("Content-Type", ""))
+            except FilerClientError as e:
+                self._send(409, str(e).encode(), "text/plain")
+                return
+            self._send(201)
+
+        def do_MKCOL(self):
+            path = self._dav_path()
+            fp = dav.fpath(path)
+            d, _, name = fp.rpartition("/")
+            try:
+                dav.filer.mkdir(d or "/", name)
+            except FilerClientError as e:
+                self._send(409, str(e).encode(), "text/plain")
+                return
+            self._send(201)
+
+        def do_DELETE(self):
+            path = self._dav_path()
+            if self._lookup(path) is None:
+                self._send(404)
+                return
+            try:
+                dav.filer.delete_data(dav.fpath(path), recursive=True)
+            except FilerClientError as e:
+                self._send(409, str(e).encode(), "text/plain")
+                return
+            self._send(204)
+
+        def _destination(self) -> Optional[str]:
+            dest = self.headers.get("Destination", "")
+            if not dest:
+                return None
+            p = urllib.parse.unquote(urllib.parse.urlsplit(dest).path)
+            return p if p == "/" else p.rstrip("/")
+
+        def do_MOVE(self):
+            src = self._dav_path()
+            dst = self._destination()
+            if dst is None or self._lookup(src) is None:
+                self._send(404 if dst else 400)
+                return
+            sf, df = dav.fpath(src), dav.fpath(dst)
+            sd, _, sn = sf.rpartition("/")
+            dd, _, dn = df.rpartition("/")
+            dav.filer.rename(sd or "/", sn, dd or "/", dn)
+            self._send(201)
+
+        def do_COPY(self):
+            src = self._dav_path()
+            dst = self._destination()
+            entry = self._lookup(src)
+            if dst is None or entry is None:
+                self._send(404 if dst else 400)
+                return
+            if entry.is_directory:
+                self._send(501)  # collection COPY not supported
+                return
+            df = dav.fpath(dst)
+            dd, _, dn = df.rpartition("/")
+            from ..pb import filer_pb2
+
+            dup = filer_pb2.Entry()
+            dup.CopyFrom(entry)
+            dup.name = dn
+            try:
+                dav.filer.create(dd or "/", dup)
+            except FilerClientError as e:
+                self._send(409, str(e).encode(), "text/plain")
+                return
+            self._send(201)
+
+    return Handler
+
+
+def main(argv: list[str]) -> int:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(prog="webdav")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-root", default="/",
+                   help="filer directory served as the DAV root")
+    args = p.parse_args(argv)
+    srv = WebDavServer(args.filer, ip=args.ip, port=args.port,
+                       root=args.root).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0

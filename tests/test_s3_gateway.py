@@ -1,0 +1,198 @@
+"""S3 gateway end-to-end: buckets, objects, listings, multipart, auth."""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.gateway.s3 import S3Gateway
+from seaweedfs_tpu.gateway.s3_auth import Identity, sign_request_headers
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(scope="module")
+def s3(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=11).start()
+    store = Store([tmp_path_factory.mktemp("s3vol")], max_volumes=8)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    gw = S3Gateway(filer.url, port=_free_port_pair()).start()
+    yield gw
+    gw.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _req(gw, method, path, data=None, headers=None, query=""):
+    url = f"http://{gw.url}{path}" + (f"?{query}" if query else "")
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_bucket_lifecycle(s3):
+    with _req(s3, "PUT", "/mybucket") as r:
+        assert r.status == 200
+    body = _req(s3, "GET", "/").read()
+    names = [b.find(f"{NS}Name").text for b in
+             ET.fromstring(body).iter(f"{NS}Bucket")]
+    assert "mybucket" in names
+    # duplicate -> 409
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "PUT", "/mybucket")
+    assert ei.value.code == 409
+
+
+def test_object_put_get_head_delete(s3):
+    _req(s3, "PUT", "/objbkt")
+    payload = np.random.default_rng(0).integers(
+        0, 256, 100_000, dtype=np.uint8).tobytes()
+    with _req(s3, "PUT", "/objbkt/dir/data.bin", data=payload,
+              headers={"Content-Type": "application/x-test"}) as r:
+        assert r.status == 200
+    with _req(s3, "GET", "/objbkt/dir/data.bin") as r:
+        assert r.read() == payload
+        assert r.headers["Content-Type"] == "application/x-test"
+    with _req(s3, "HEAD", "/objbkt/dir/data.bin") as r:
+        assert int(r.headers["Content-Length"]) == len(payload)
+    # range
+    req = urllib.request.Request(
+        f"http://{s3.url}/objbkt/dir/data.bin",
+        headers={"Range": "bytes=10-99"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 206
+        assert r.read() == payload[10:100]
+    with _req(s3, "DELETE", "/objbkt/dir/data.bin") as r:
+        assert r.status == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/objbkt/dir/data.bin")
+    assert ei.value.code == 404
+
+
+def test_list_objects_v2_prefix_delimiter(s3):
+    _req(s3, "PUT", "/listbkt")
+    for key in ("a/1.txt", "a/2.txt", "b/3.txt", "top.txt"):
+        _req(s3, "PUT", f"/listbkt/{key}", data=b"x")
+    body = _req(s3, "GET", "/listbkt", query="list-type=2").read()
+    keys = [c.find(f"{NS}Key").text for c in
+            ET.fromstring(body).iter(f"{NS}Contents")]
+    assert keys == ["a/1.txt", "a/2.txt", "b/3.txt", "top.txt"]
+    body = _req(s3, "GET", "/listbkt",
+                query="list-type=2&delimiter=/").read()
+    root = ET.fromstring(body)
+    keys = [c.find(f"{NS}Key").text for c in root.iter(f"{NS}Contents")]
+    cps = [c.find(f"{NS}Prefix").text
+           for c in root.iter(f"{NS}CommonPrefixes")]
+    assert keys == ["top.txt"]
+    assert cps == ["a/", "b/"]
+    body = _req(s3, "GET", "/listbkt",
+                query="list-type=2&prefix=a/").read()
+    keys = [c.find(f"{NS}Key").text for c in
+            ET.fromstring(body).iter(f"{NS}Contents")]
+    assert keys == ["a/1.txt", "a/2.txt"]
+
+
+def test_copy_object(s3):
+    _req(s3, "PUT", "/cpbkt")
+    _req(s3, "PUT", "/cpbkt/src.bin", data=b"copy me")
+    with _req(s3, "PUT", "/cpbkt/dst.bin",
+              headers={"x-amz-copy-source": "/cpbkt/src.bin"}) as r:
+        assert r.status == 200
+    assert _req(s3, "GET", "/cpbkt/dst.bin").read() == b"copy me"
+
+
+def test_multipart_upload(s3):
+    _req(s3, "PUT", "/mpbkt")
+    rng = np.random.default_rng(1)
+    parts = [rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+             for _ in range(3)]
+    body = _req(s3, "POST", "/mpbkt/big/file.bin",
+                query="uploads").read()
+    upload_id = ET.fromstring(body).find(f"{NS}UploadId").text
+    for i, part in enumerate(parts, start=1):
+        with _req(s3, "PUT", "/mpbkt/big/file.bin", data=part,
+                  query=f"partNumber={i}&uploadId={upload_id}") as r:
+            assert r.status == 200
+    body = _req(s3, "POST", "/mpbkt/big/file.bin",
+                query=f"uploadId={upload_id}").read()
+    assert ET.fromstring(body).find(f"{NS}Key").text == "big/file.bin"
+    got = _req(s3, "GET", "/mpbkt/big/file.bin").read()
+    assert got == b"".join(parts)
+
+
+def test_sigv4_auth(tmp_path_factory):
+    """Auth-enabled gateway accepts correctly signed requests only."""
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=13).start()
+    store = Store([tmp_path_factory.mktemp("authvol")], max_volumes=4)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    ident = Identity(name="admin", access_key="AK123",
+                     secret_key="SK456")
+    gw = S3Gateway(filer.url, port=_free_port_pair(),
+                   identities=[ident]).start()
+    try:
+        # unsigned -> 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(gw, "PUT", "/secure")
+        assert ei.value.code == 403
+        # signed -> ok
+        url = f"http://{gw.url}/secure"
+        hdrs = sign_request_headers("PUT", url, {}, b"", "AK123",
+                                    "SK456")
+        req = urllib.request.Request(url, method="PUT", headers=hdrs)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        # wrong secret -> 403
+        hdrs = sign_request_headers("PUT", f"http://{gw.url}/nope",
+                                    {}, b"", "AK123", "WRONG")
+        req = urllib.request.Request(f"http://{gw.url}/nope",
+                                     method="PUT", headers=hdrs)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 403
+    finally:
+        gw.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()

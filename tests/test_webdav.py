@@ -1,0 +1,114 @@
+"""WebDAV gateway: PROPFIND/PUT/GET/MKCOL/MOVE/COPY/DELETE round trips."""
+
+import socket
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.gateway.webdav import WebDavServer
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+D = "{DAV:}"
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(scope="module")
+def dav(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=21).start()
+    store = Store([tmp_path_factory.mktemp("davvol")], max_volumes=4)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    srv = WebDavServer(filer.url, port=_free_port_pair()).start()
+    yield srv
+    srv.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _req(dav_srv, method, path, data=None, headers=None):
+    req = urllib.request.Request(f"http://{dav_srv.url}{path}",
+                                 data=data, method=method,
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_options_advertises_dav(dav):
+    with _req(dav, "OPTIONS", "/") as r:
+        assert r.headers["DAV"] == "1"
+        assert "PROPFIND" in r.headers["Allow"]
+
+
+def test_mkcol_put_get_propfind(dav):
+    with _req(dav, "MKCOL", "/projects") as r:
+        assert r.status == 201
+    with _req(dav, "PUT", "/projects/notes.txt",
+              data=b"dav payload") as r:
+        assert r.status == 201
+    assert _req(dav, "GET", "/projects/notes.txt").read() == \
+        b"dav payload"
+    with _req(dav, "PROPFIND", "/projects",
+              headers={"Depth": "1"}) as r:
+        assert r.status == 207
+        ms = ET.fromstring(r.read())
+    hrefs = [h.text for h in ms.iter(f"{D}href")]
+    assert "/projects/" in hrefs
+    assert "/projects/notes.txt" in hrefs
+    sizes = [s.text for s in ms.iter(f"{D}getcontentlength")]
+    assert "11" in sizes
+
+
+def test_move_and_copy(dav):
+    _req(dav, "MKCOL", "/mv")
+    _req(dav, "PUT", "/mv/a.txt", data=b"A")
+    with _req(dav, "MOVE", "/mv/a.txt",
+              headers={"Destination":
+                       f"http://{dav.url}/mv/b.txt"}) as r:
+        assert r.status == 201
+    with pytest.raises(urllib.error.HTTPError):
+        _req(dav, "GET", "/mv/a.txt")
+    assert _req(dav, "GET", "/mv/b.txt").read() == b"A"
+    with _req(dav, "COPY", "/mv/b.txt",
+              headers={"Destination":
+                       f"http://{dav.url}/mv/c.txt"}) as r:
+        assert r.status == 201
+    assert _req(dav, "GET", "/mv/c.txt").read() == b"A"
+    assert _req(dav, "GET", "/mv/b.txt").read() == b"A"
+
+
+def test_delete(dav):
+    _req(dav, "PUT", "/gone.txt", data=b"x")
+    with _req(dav, "DELETE", "/gone.txt") as r:
+        assert r.status == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(dav, "GET", "/gone.txt")
+    assert ei.value.code == 404
