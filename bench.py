@@ -1,37 +1,151 @@
 """Benchmark: RS(10,4) encode throughput on the available accelerator.
 
 Prints ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, ...}
 
 ``vs_baseline`` is measured against the BASELINE.md target of 20 GiB/s
-RS(10,4) encode per chip (BASELINE.json north star). Detailed sub-metrics
-(rebuild throughput, end-to-end with host transfers, alternate
-geometries) go to stderr so the driver's one-line contract holds.
+RS(10,4) encode per chip (BASELINE.json north star). Sub-metrics (rebuild,
+end-to-end file path, alternate geometries, CPU baseline) ride in the same
+JSON under ``extras`` and are echoed to stderr.
 
-Run on the real TPU with a plain ``python bench.py`` (single process —
-the axon tunnel is exclusive); CPU fallback works with
-``JAX_PLATFORMS=cpu`` for smoke-testing.
+Hardened against a hung/unavailable TPU tunnel (the axon PJRT plugin can
+hang at first backend init): the parent process imports NO jax. It probes
+the backend in a subprocess with a watchdog + retry; on persistent failure
+it re-runs the benchmark in a scrubbed-environment CPU subprocess
+(PYTHONPATH without the sitecustomize hook, JAX_PLATFORMS=cpu) and STILL
+prints the one-line JSON with ``"platform": "cpu", "degraded": true``.
+This process never exits nonzero.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 TARGET_GIBPS = 20.0
 GIB = 1024 ** 3
+
+PROBE_TIMEOUT = 75       # backend-init watchdog, per attempt
+PROBE_ATTEMPTS = 2
+BENCH_TIMEOUT = 900      # full benchmark child watchdog
+SELF = os.path.abspath(__file__)
+REPO = os.path.dirname(SELF)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# --------------------------------------------------------------------------
+# parent-side process management (stdlib only — jax is never imported here)
+# --------------------------------------------------------------------------
+
+def _scrubbed_env(n_cpu_devices: int = 0) -> dict:
+    """Environment with the axon sitecustomize hook removed and JAX forced
+    to the in-process CPU backend (the recipe VERDICT.md verified)."""
+    sys.path.insert(0, REPO)
+    from seaweedfs_tpu.util.scrub import scrubbed_env
+    return scrubbed_env(REPO, n_cpu_devices)
+
+
+def _ambient_env() -> dict:
+    env = dict(os.environ)
+    pp = env.get("PYTHONPATH", "").split(os.pathsep)
+    if REPO not in pp:
+        env["PYTHONPATH"] = os.pathsep.join([REPO] + [p for p in pp if p])
+    return env
+
+
+def _run(args: list, env: dict, timeout: int):
+    """Run a child, streaming its stderr through; returns (rc, stdout)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, SELF] + args, env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=sys.stderr,
+            timeout=timeout, text=True)
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired:
+        return -1, ""
+    except Exception as e:  # noqa: BLE001 — parent must never die
+        log(f"bench child failed to launch: {e}")
+        return -2, ""
+
+
+def probe_tpu() -> str | None:
+    """Return the accelerator platform name, or None if the backend is
+    unusable (hang, crash, or CPU-only)."""
+    for attempt in range(PROBE_ATTEMPTS):
+        if attempt:
+            time.sleep(10)
+        t0 = time.perf_counter()
+        rc, out = _run(["--probe"], _ambient_env(), PROBE_TIMEOUT)
+        dt = time.perf_counter() - t0
+        platform = out.strip().splitlines()[-1] if out.strip() else ""
+        log(f"tpu probe attempt {attempt + 1}/{PROBE_ATTEMPTS}: rc={rc} "
+            f"platform={platform!r} ({dt:.1f}s)")
+        if rc == 0 and platform and platform != "cpu":
+            return platform
+    return None
+
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def parent() -> None:
+    platform = probe_tpu()
+    result = None
+    if platform is not None:
+        rc, out = _run(["--child"], _ambient_env(), BENCH_TIMEOUT)
+        result = _parse_result(out)
+        if result is None:
+            log(f"tpu benchmark child failed (rc={rc}); "
+                "falling back to CPU")
+    if result is not None:
+        result["platform"] = platform
+        result["degraded"] = False
+        emit(result)
+        return
+    rc, out = _run(["--child"], _scrubbed_env(), BENCH_TIMEOUT)
+    result = _parse_result(out)
+    if result is not None:
+        result["platform"] = "cpu"
+        result["degraded"] = True
+        emit(result)
+        return
+    emit({
+        "metric": "rs_10_4_encode_1gib_device",
+        "value": 0.0,
+        "unit": "GiB/s",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "degraded": True,
+        "error": f"benchmark child failed on every backend (last rc={rc})",
+    })
+
+
+def _parse_result(out: str):
+    for line in reversed(out.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+            return obj
+    return None
+
+
+# --------------------------------------------------------------------------
+# child-side: the actual measurements (runs under a watchdog)
+# --------------------------------------------------------------------------
+
 def timeit(fn, *args, warmup=2, iters=5):
     """Median wall time of jitted fn(*args) with block_until_ready."""
     import jax
+    import numpy as np
     for _ in range(warmup):
         out = fn(*args)
         jax.block_until_ready(out)
@@ -44,15 +158,16 @@ def timeit(fn, *args, warmup=2, iters=5):
     return float(np.median(times))
 
 
-def main() -> None:
+def child() -> None:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from seaweedfs_tpu.ops import bitslice, rs_pallas
+    from seaweedfs_tpu.ops import rs_jax
     from seaweedfs_tpu.ops.rs_jax import Encoder
 
-    from seaweedfs_tpu.ops import rs_jax
-
+    extras: dict = {}
     dev = jax.devices()[0]
     log(f"device: {dev} platform={dev.platform}")
     # Same dispatch policy as the codec itself: Mosaic kernels only on
@@ -103,6 +218,7 @@ def main() -> None:
 
     t_r = timeit(rebuild_fn, x)  # x's first 10 rows stand in as survivors
     rebuild_gibps = total_bytes / GIB / t_r
+    extras["rebuild_1shard_gibps"] = round(rebuild_gibps, 3)
     log(f"single-shard rebuild: {t_r*1e3:.2f} ms -> "
         f"{rebuild_gibps:.2f} GiB/s (target 15)")
 
@@ -119,23 +235,49 @@ def main() -> None:
             return gf_apply(_c, v)
 
         t_a = timeit(alt_fn, ax, warmup=1, iters=3)
-        log(f"RS({ak},{am}) encode: "
-            f"{batch * ak * a_s / GIB / t_a:.2f} GiB/s")
+        alt_gibps = batch * ak * a_s / GIB / t_a
+        extras[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
+        log(f"RS({ak},{am}) encode: {alt_gibps:.2f} GiB/s")
+
+    # -- end-to-end: synthetic .dat file -> 14 shard files (config 1) -----
+    try:
+        e2e_gibps = _bench_end_to_end(on_tpu)
+        extras["encode_e2e_file_gibps"] = round(e2e_gibps, 3)
+    except Exception as e:  # noqa: BLE001 — sub-benches never kill the run
+        log(f"end-to-end bench unavailable: {e}")
+
+    # -- multi-volume coalesced batch encode (config 3) -------------------
+    try:
+        c3 = _bench_many_volumes(on_tpu)
+        extras["many_volumes_gibps"] = round(c3, 3)
+    except Exception as e:  # noqa: BLE001
+        log(f"config-3 bench unavailable: {e}")
+
+    # -- repair under load (config 5) -------------------------------------
+    try:
+        c5 = _bench_repair_under_load(on_tpu)
+        extras.update(c5)
+    except Exception as e:  # noqa: BLE001
+        log(f"config-5 bench unavailable: {e}")
 
     # -- reference-class CPU baseline: native AVX2 codec ------------------
     # The reference's hot loop is klauspost's SIMD Galois assembly; our
-    # native/gf256_rs.cpp is the same nibble-LUT kernel, so its measured
-    # rate IS the AVX2-class baseline the north star's ">= 10x CPU"
-    # clause refers to (BASELINE.md last row).
+    # native/gf256_rs.cpp implements the same nibble-LUT kernel, so its
+    # measured rate is this host's AVX2-class baseline for the north
+    # star's ">= 10x CPU" clause (BASELINE.md last row).
     try:
         from seaweedfs_tpu.ops import rs_native
         cx = np.random.default_rng(0).integers(
             0, 256, (k, 16 * 1024 * 1024), dtype=np.uint8)
         rs_native.apply_gf_matrix(coefs, cx)  # warm (builds .so, tables)
-        t0 = time.perf_counter()
-        rs_native.apply_gf_matrix(coefs, cx)
-        t_cpu = time.perf_counter() - t0
-        cpu_gibps = cx.size / GIB / t_cpu
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rs_native.apply_gf_matrix(coefs, cx)
+            best = min(best, time.perf_counter() - t0)
+        cpu_gibps = cx.size / GIB / best
+        extras["cpu_avx2_baseline_gibps"] = round(cpu_gibps, 3)
+        extras["speedup_vs_cpu"] = round(encode_gibps / cpu_gibps, 2)
         log(f"native AVX2 CPU baseline: {cpu_gibps:.2f} GiB/s "
             f"(simd level {rs_native.simd_level()}); "
             f"device speedup {encode_gibps / cpu_gibps:.1f}x")
@@ -147,8 +289,94 @@ def main() -> None:
         "value": round(encode_gibps, 3),
         "unit": "GiB/s",
         "vs_baseline": round(encode_gibps / TARGET_GIBPS, 3),
+        "extras": extras,
     }), flush=True)
 
 
+def _bench_end_to_end(on_tpu: bool) -> float:
+    """Config 1 end-to-end: synthetic .dat on disk -> 14 shard files,
+    through the pipelined encode path (disk read / H2D / compute / D2H
+    overlap). Returns GiB/s of .dat bytes processed."""
+    import tempfile
+
+    import numpy as np
+
+    from seaweedfs_tpu.pipeline import encode as encode_mod
+    from seaweedfs_tpu.storage import superblock as superblock_mod
+    from seaweedfs_tpu.storage import volume as volume_mod
+
+    size = GIB if on_tpu else 64 * 1024 * 1024
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "1")
+        rng = np.random.default_rng(7)
+        with open(volume_mod.dat_path(base), "wb") as f:
+            f.write(superblock_mod.SuperBlock().to_bytes())
+            remaining = size - 8
+            chunk = 64 * 1024 * 1024
+            while remaining > 0:
+                n = min(chunk, remaining)
+                f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+                remaining -= n
+        t0 = time.perf_counter()
+        encode_mod.write_ec_files(base)
+        dt = time.perf_counter() - t0
+        gibps = size / GIB / dt
+        log(f"end-to-end file encode ({size / GIB:.2f} GiB .dat): "
+            f"{dt:.2f} s -> {gibps:.2f} GiB/s")
+        return gibps
+
+
+def _bench_many_volumes(on_tpu: bool) -> float:
+    """Config 3: many small volumes coalesced into large device batches.
+    Uses in-memory volume payloads (the batcher's device path) to measure
+    aggregate encode throughput."""
+    import numpy as np
+
+    from seaweedfs_tpu.pipeline import batch as batch_mod
+
+    n_volumes = 1000 if on_tpu else 32
+    vol_bytes = 30 * 1024 * 1024 if on_tpu else 1024 * 1024
+    rng = np.random.default_rng(3)
+    payloads = [rng.integers(0, 256, vol_bytes, dtype=np.uint8)
+                for _ in range(n_volumes)]
+    # warm: compile on a single small batch
+    batch_mod.encode_many(payloads[:2])
+    t0 = time.perf_counter()
+    batch_mod.encode_many(payloads)
+    dt = time.perf_counter() - t0
+    total = n_volumes * vol_bytes
+    gibps = total / GIB / dt
+    log(f"config-3 coalesced encode ({n_volumes} x "
+        f"{vol_bytes / 1024 / 1024:.0f} MB): {dt:.2f} s -> "
+        f"{gibps:.2f} GiB/s aggregate")
+    return gibps
+
+
+def _bench_repair_under_load(on_tpu: bool) -> dict:
+    """Config 5: streaming 4-shard-loss decode while 64-QPS concurrent
+    interval repairs ride the micro-batch aggregator. Returns sustained
+    decode GiB/s and read p99 latency."""
+    from seaweedfs_tpu.pipeline import repair_bench
+
+    res = repair_bench.run(
+        duration_s=8.0 if on_tpu else 3.0,
+        qps=64,
+        shard_len=(32 * 1024 * 1024) if on_tpu else (2 * 1024 * 1024))
+    log(f"config-5 repair-under-load: decode {res['decode_gibps']:.2f} "
+        f"GiB/s sustained, read p99 {res['read_p99_ms']:.2f} ms")
+    return {"repair_decode_gibps": round(res["decode_gibps"], 3),
+            "repair_read_p99_ms": round(res["read_p99_ms"], 3)}
+
+
+def probe_child() -> None:
+    import jax
+    print(jax.devices()[0].platform, flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        probe_child()
+    elif "--child" in sys.argv:
+        child()
+    else:
+        parent()

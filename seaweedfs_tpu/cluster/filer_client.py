@@ -125,6 +125,55 @@ class FilerClient:
             raise FilerClientError(
                 f"GET {path}: {e.code}") from e
 
+    def copy_data(self, src_path: str, dst_path: str, size: int,
+                  mime: str = "", window: int = 32 * 1024 * 1024,
+                  extended: Optional[dict] = None,
+                  file_mode: int = 0) -> int:
+        """Materialize ``dst_path`` as a byte copy of ``src_path`` so the
+        destination owns FRESH chunks (sharing chunk file_ids would turn
+        a later delete/overwrite of either file into silent data loss
+        for the other). Windowed to bound memory on large files; windows
+        after the first ride the filer's ``op=append``. ``extended`` /
+        ``file_mode`` are carried onto the new entry afterwards.
+
+        Self-copy is a no-op (the first window's overwrite would reclaim
+        the source's own chunks and truncate it). ANY mid-copy failure —
+        short read, source deleted (404), source shrank (range error) —
+        removes the partial destination and raises, never leaving a
+        truncated copy that later GETs would serve as intact."""
+        if src_path == dst_path:
+            return 0
+        off = 0
+        try:
+            if size == 0:
+                self.put_data(dst_path, b"", mime=mime)
+            while off < size:
+                data = self.get_data(src_path, off,
+                                     min(window, size - off))
+                if not data:
+                    raise FilerClientError(
+                        f"short read copying {src_path} at {off}/{size} "
+                        "(source changed mid-copy)")
+                self.put_data(dst_path, data, mime=mime,
+                              query="op=append" if off else "")
+                off += len(data)
+        except Exception:
+            try:
+                self.delete_data(dst_path)
+            except FilerClientError:
+                pass
+            raise
+        if extended or file_mode:
+            d, _, n = dst_path.rpartition("/")
+            dup = self.lookup(d or "/", n)
+            if dup is not None:
+                for k, v in (extended or {}).items():
+                    dup.extended[k] = v
+                if file_mode:
+                    dup.attributes.file_mode = file_mode
+                self.create(d or "/", dup)
+        return off
+
     def delete_data(self, path: str, recursive: bool = False) -> None:
         q = "recursive=true" if recursive else ""
         req = urllib.request.Request(self._url(path, q), method="DELETE")

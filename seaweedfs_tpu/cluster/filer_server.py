@@ -335,7 +335,8 @@ def _make_http_handler(fs: FilerServer):
                     mime=ctype if not ctype.startswith(
                         "multipart/") else "",
                     chunk_size=int(q["maxMB"]) * 1024 * 1024
-                    if "maxMB" in q else None)
+                    if "maxMB" in q else None,
+                    append=q.get("op") == "append")
             except FilerError as e:
                 self._err(409, str(e))
                 return

@@ -209,30 +209,46 @@ class Filer:
         from ..cluster import operation
 
         chunk_size = chunk_size or self.CHUNK_SIZE
-        existing = self.store.find_entry(normalize_path(path))
-        base_off = 0
-        chunks: list[FileChunk] = []
-        if append and existing is not None:
-            chunks = list(existing.chunks)
-            base_off = total_size(chunks)
+        # Upload outside any lock (slow), with 0-based offsets; the
+        # append base is only decided at commit time, under the lock.
         now_ns = time.time_ns()
+        new_chunks: list[FileChunk] = []
         for off in range(0, len(data), chunk_size):
             piece = data[off:off + chunk_size]
             a = operation.assign(master, 1, collection, replication)
             operation.upload(a.url, a.fid, bytes(piece), jwt=a.auth,
                              collection=collection)
-            chunks.append(FileChunk(file_id=a.fid,
-                                    offset=base_off + off,
-                                    size=len(piece), mtime_ns=now_ns))
-        attr = existing.attr if (append and existing is not None) else \
-            Attr(collection=collection, replication=replication,
-                 mime=mime)
-        attr.mtime = time.time()
-        entry = Entry(path=path, attr=attr, chunks=chunks)
-        self.create_entry(entry)
-        if existing is not None and not append:
-            self._delete_chunks_via(master, existing.chunks,
-                                    existing.attr.collection)
+            new_chunks.append(FileChunk(file_id=a.fid, offset=off,
+                                        size=len(piece),
+                                        mtime_ns=now_ns))
+        # Commit under the namespace lock against the entry that is
+        # ACTUALLY there now — two concurrent writers both observed the
+        # same pre-upload entry, so basing the append offsets or the
+        # chunk reclaim on that stale read would drop the other
+        # writer's bytes / leak the loser's freshly uploaded blobs.
+        with self._ns_lock:
+            current = self.store.find_entry(normalize_path(path))
+            if append and current is not None:
+                base = total_size(current.chunks)
+                chunks = list(current.chunks) + [
+                    FileChunk(file_id=c.file_id, offset=base + c.offset,
+                              size=c.size, mtime_ns=c.mtime_ns)
+                    for c in new_chunks]
+                attr = current.attr
+            else:
+                chunks = new_chunks
+                attr = Attr(collection=collection,
+                            replication=replication, mime=mime)
+            attr.mtime = time.time()
+            entry = Entry(path=path, attr=attr, chunks=chunks)
+            self.create_entry(entry)
+        if current is not None and not append:
+            new_ids = {c.file_id for c in chunks}
+            stale = [c for c in current.chunks
+                     if c.file_id not in new_ids]
+            if stale:
+                self._delete_chunks_via(master, stale,
+                                        current.attr.collection)
         return entry
 
     def read_file(self, path: str, master, offset: int = 0,

@@ -56,6 +56,7 @@ _STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
            "AuthorizationHeaderMalformed": 400,
            "AuthorizationQueryParametersError": 400,
            "InvalidPart": 400, "MalformedXML": 400,
+           "InvalidRange": 416, "RequestTimeTooSkewed": 403,
            "InternalError": 500}
 
 
@@ -152,10 +153,14 @@ class S3Gateway:
         else:
             after = q.get("marker", "")
         base = f"{BUCKETS_DIR}/{bucket}"
-        contents: list[tuple[str, filer_pb2.Entry]] = []
-        prefixes: list[str] = []
-        truncated = self._walk(base, "", prefix, delimiter, after,
-                               max_keys, contents, prefixes)
+        # items: ("key", key, entry) | ("prefix", prefix, None), in key
+        # order — one list so the continuation token is always the last
+        # EMITTED name, whether that was an object or a common prefix.
+        items: list[tuple[str, str, Optional[filer_pb2.Entry]]] = []
+        # max-keys=0 is legal: answer IsTruncated=false with no items
+        # (matching AWS) instead of a token-less truncated response.
+        truncated = max_keys > 0 and self._walk(
+            base, "", prefix, delimiter, after, max_keys, items)
         root = ET.Element(
             "ListBucketResult", xmlns=XMLNS)
         ET.SubElement(root, "Name").text = bucket
@@ -166,14 +171,17 @@ class S3Gateway:
         if delimiter:
             ET.SubElement(root, "Delimiter").text = delimiter
         if v2:
-            ET.SubElement(root, "KeyCount").text = str(
-                len(contents) + len(prefixes))
-            if truncated and contents:
+            ET.SubElement(root, "KeyCount").text = str(len(items))
+            if truncated and items:
                 ET.SubElement(root, "NextContinuationToken").text = \
-                    contents[-1][0]
-        elif truncated and contents:
-            ET.SubElement(root, "NextMarker").text = contents[-1][0]
-        for key, e in contents:
+                    items[-1][1]
+        elif truncated and items:
+            ET.SubElement(root, "NextMarker").text = items[-1][1]
+        for kind, key, e in items:
+            if kind == "prefix":
+                cp = ET.SubElement(root, "CommonPrefixes")
+                ET.SubElement(cp, "Prefix").text = key
+                continue
             c = ET.SubElement(root, "Contents")
             ET.SubElement(c, "Key").text = key
             ET.SubElement(c, "LastModified").text = _iso(
@@ -181,15 +189,13 @@ class S3Gateway:
             ET.SubElement(c, "ETag").text = f'"{_etag(e)}"'
             ET.SubElement(c, "Size").text = str(e.attributes.file_size)
             ET.SubElement(c, "StorageClass").text = "STANDARD"
-        for p in prefixes:
-            cp = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(cp, "Prefix").text = p
         return _xml(root)
 
     def _walk(self, base: str, rel: str, prefix: str, delimiter: str,
-              after: str, max_keys: int,
-              contents: list, prefixes: list) -> bool:
-        """DFS in key order; returns True when truncated."""
+              after: str, max_keys: int, items: list) -> bool:
+        """DFS in key order; returns True when truncated. Common
+        prefixes count against max-keys at append time, same as keys
+        (the S3 contract: MaxKeys bounds keys + CommonPrefixes)."""
         directory = f"{base}/{rel}" if rel else base
         for e in self.filer.list(directory):
             key = f"{rel}{e.name}" if not e.is_directory else \
@@ -203,17 +209,19 @@ class S3Gateway:
             if e.is_directory:
                 if delimiter == "/" and key.startswith(prefix):
                     if key > after:
-                        prefixes.append(key)
+                        if len(items) >= max_keys:
+                            return True
+                        items.append(("prefix", key, None))
                     continue
                 if self._walk(base, key, prefix, delimiter, after,
-                              max_keys, contents, prefixes):
+                              max_keys, items):
                     return True
                 continue
             if not key.startswith(prefix) or key <= after:
                 continue
-            if len(contents) + len(prefixes) >= max_keys:
+            if len(items) >= max_keys:
                 return True
-            contents.append((key, e))
+            items.append(("key", key, e))
         return False
 
     # ---- object ops ----
@@ -247,19 +255,29 @@ class S3Gateway:
         except FilerClientError:
             pass  # S3 deletes are idempotent
 
+    #: Copy window: bounds gateway memory and keeps each filer PUT well
+    #: inside the HTTP client timeout for arbitrarily large objects.
+    COPY_WINDOW = 32 * 1024 * 1024
+
     def copy_object(self, bucket: str, key: str, src_bucket: str,
                     src_key: str) -> bytes:
         src = self.get_object_entry(src_bucket, src_key)
         self._require_bucket(bucket)
-        dst_dir, _, dst_name = \
-            f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
-        dup = filer_pb2.Entry()
-        dup.CopyFrom(src)
-        dup.name = dst_name
-        self.filer.create(dst_dir, dup)
+        src_path = f"{BUCKETS_DIR}/{src_bucket}/{src_key}"
+        dst_path = f"{BUCKETS_DIR}/{bucket}/{key}"
+        # Self-copy (the S3 metadata-refresh idiom) must not touch the
+        # data path: copy_data no-ops, and the entry stays as-is.
+        self.filer.copy_data(src_path, dst_path,
+                             src.attributes.file_size,
+                             mime=src.attributes.mime,
+                             window=self.COPY_WINDOW,
+                             extended=dict(src.extended))
+        # Report the DESTINATION's ETag: the copy has its own chunk ids,
+        # so echoing the source's would mismatch a later GET/HEAD.
+        dst = self.get_object_entry(bucket, key)
         root = ET.Element("CopyObjectResult", xmlns=XMLNS)
         ET.SubElement(root, "LastModified").text = _iso(time.time())
-        ET.SubElement(root, "ETag").text = f'"{_etag(src)}"'
+        ET.SubElement(root, "ETag").text = f'"{_etag(dst)}"'
         return _xml(root)
 
     # ---- multipart ----
@@ -334,6 +352,37 @@ class S3Gateway:
         self._upload_dir(upload_id)
         self.filer.delete(f"{BUCKETS_DIR}/{UPLOADS_DIR}", upload_id,
                           recursive=True, delete_data=True)
+
+
+def _parse_s3_range(header, size: int):
+    """S3 single-range semantics: returns (offset, length), or None to
+    serve the full body with 200 (absent/malformed headers are ignored,
+    per RFC 7233). Raises InvalidRange (416) when the range is
+    syntactically valid but unsatisfiable, e.g. ``bytes=500-`` on a
+    100-byte object."""
+    if not header or not header.startswith("bytes=") or not size:
+        return None
+    spec = header[6:].split(",")[0].strip()
+    lo, sep, hi = spec.partition("-")
+    if not sep:
+        return None
+    try:
+        if not lo:  # suffix: last N bytes
+            n = int(hi)
+            if n <= 0:
+                return None
+            offset = max(0, size - n)
+            return offset, size - offset
+        offset = int(lo)
+        stop = int(hi) + 1 if hi else size
+    except ValueError:
+        return None
+    if offset < 0 or (hi and stop <= offset):
+        return None  # malformed (last-byte-pos < first-byte-pos)
+    if offset >= size:
+        raise S3Error("InvalidRange",
+                      f"range start {offset} beyond object size {size}")
+    return offset, min(stop, size) - offset
 
 
 def _etag(e: filer_pb2.Entry) -> str:
@@ -415,18 +464,12 @@ def _make_handler(gw: S3Gateway):
                 else:
                     entry = gw.get_object_entry(bucket, key)
                     size = entry.attributes.file_size
-                    rng = self.headers.get("Range")
                     offset, length = 0, None
                     status, extra = 200, {}
-                    if rng and rng.startswith("bytes=") and size:
-                        lo, _, hi = rng[6:].partition("-")
-                        if lo:
-                            offset = int(lo)
-                            stop = int(hi) + 1 if hi else size
-                        else:
-                            offset = max(0, size - int(hi))
-                            stop = size
-                        length = max(0, min(stop, size) - offset)
+                    parsed = _parse_s3_range(
+                        self.headers.get("Range"), size)
+                    if parsed is not None:
+                        offset, length = parsed
                         status = 206
                         extra["Content-Range"] = \
                             f"bytes {offset}-{offset + length - 1}" \

@@ -54,6 +54,30 @@ def _canonical_query(query: str, drop_signature: bool = False) -> str:
         f"{urllib.parse.quote(v, safe='-_.~')}" for k, v in pairs)
 
 
+#: Maximum tolerated |server clock - x-amz-date|, matching the
+#: reference's (and AWS's) ~15-minute skew window — without it a
+#: captured signed request replays successfully forever.
+MAX_CLOCK_SKEW_S = 15 * 60
+
+
+def _check_date_freshness(amz_date: str, cred_date: str) -> None:
+    import calendar
+    import time as _time
+
+    try:
+        t0 = calendar.timegm(_time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    except ValueError as e:
+        raise AuthError("AccessDenied",
+                        f"malformed x-amz-date {amz_date!r}") from e
+    if not amz_date.startswith(cred_date):
+        raise AuthError("AccessDenied",
+                        "credential scope date does not match x-amz-date")
+    if abs(_time.time() - t0) > MAX_CLOCK_SKEW_S:
+        raise AuthError("RequestTimeTooSkewed",
+                        "x-amz-date outside the accepted clock-skew "
+                        "window")
+
+
 class SigV4Verifier:
     def __init__(self, identities: Optional[list[Identity]] = None):
         self.by_access_key = {i.access_key: i
@@ -104,6 +128,7 @@ class SigV4Verifier:
         amz_date = headers.get("x-amz-date") or headers.get("X-Amz-Date")
         if not amz_date:
             raise AuthError("AccessDenied", "missing x-amz-date")
+        _check_date_freshness(amz_date, date)
         canonical_headers = "".join(
             f"{h}:{' '.join((headers.get(h) or '').split())}\n"
             for h in signed_headers.split(";"))

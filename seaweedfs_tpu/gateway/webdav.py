@@ -244,14 +244,13 @@ def _make_handler(dav: WebDavServer):
                 self._send(501)  # collection COPY not supported
                 return
             df = dav.fpath(dst)
-            dd, _, dn = df.rpartition("/")
-            from ..pb import filer_pb2
-
-            dup = filer_pb2.Entry()
-            dup.CopyFrom(entry)
-            dup.name = dn
+            sf = dav.fpath(src)
             try:
-                dav.filer.create(dd or "/", dup)
+                dav.filer.copy_data(
+                    sf, df, entry.attributes.file_size,
+                    mime=entry.attributes.mime,
+                    extended=dict(entry.extended),
+                    file_mode=entry.attributes.file_mode)
             except FilerClientError as e:
                 self._send(409, str(e).encode(), "text/plain")
                 return

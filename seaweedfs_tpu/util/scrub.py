@@ -1,0 +1,43 @@
+"""Subprocess environment scrubbing for the hostile ambient backend.
+
+The driver launches ``bench.py`` and ``__graft_entry__`` under an
+environment where a sitecustomize hook (`.axon_site` on PYTHONPATH,
+triggered by ``PALLAS_AXON_POOL_IPS``) dials an exclusive TPU tunnel from
+EVERY Python process and can hang at first backend init. This module is
+the one shared recipe for building a child environment that provably
+avoids that: drop the hook from PYTHONPATH, remove its trigger variable,
+force the in-process CPU backend, and (optionally) force an exact
+virtual CPU device count — overriding any stale ambient value.
+
+Deliberately stdlib-only: the callers import it before any jax import.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_DEVCOUNT_RE = re.compile(
+    r"--xla_force_host_platform_device_count=\d+\s*")
+
+
+def scrubbed_env(repo_root: str, n_cpu_devices: int = 0) -> dict:
+    """A copy of ``os.environ`` safe for a CPU-only JAX child process.
+
+    ``n_cpu_devices > 1`` forces exactly that many virtual CPU devices,
+    replacing (not deferring to) any count latched in ambient
+    ``XLA_FLAGS`` — a stale count would break a mesh dry run outright.
+    """
+    env = dict(os.environ)
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and "axon" not in p]
+    pp.insert(0, repo_root)
+    env["PYTHONPATH"] = os.pathsep.join(pp)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize trigger
+    if n_cpu_devices > 1:
+        flags = _DEVCOUNT_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{n_cpu_devices}").strip()
+    return env
