@@ -18,6 +18,7 @@ import numpy as np
 
 from ..storage import ec_files, idx as idx_mod, volume as volume_mod
 from ..storage import superblock as superblock_mod
+from . import pipe
 from .scheme import DEFAULT_SCHEME, EcScheme
 from .stripe import iter_row_batches, stripe_rows
 
@@ -33,23 +34,39 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
                    max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES) -> int:
     """Generate <base>.ec00..ec<k+m-1> from <base>.dat. Returns the .dat
     size. Mirrors ec_encoder.go WriteEcFiles (data movement) wrapped
-    around the device codec (parity math)."""
+    around the device codec (parity math).
+
+    Runs as a 3-stage pipeline (pipe.py): memmap slices are materialized
+    on a reader thread, the device computes PARITY ONLY (data shards are
+    written straight from the host batch — k/m of the D2H traffic never
+    happens), and a writer thread appends while the next batch computes.
+    """
     datp = volume_mod.dat_path(base)
     if not datp.exists():
         raise EcEncodeError(f"{datp} does not exist")
     # memmap, not fromfile: host residency stays O(batch), not O(volume).
     dat = np.memmap(datp, dtype=np.uint8, mode="r") \
         if datp.stat().st_size else np.zeros(0, dtype=np.uint8)
+    k = scheme.data_shards
     outs = [open(ec_files.shard_path(base, i), "wb")
             for i in range(scheme.total_shards)]
-    try:
+
+    def batches():
         for rows, _is_large in stripe_rows(dat, scheme):
             for batch in iter_row_batches(rows, max_batch_bytes):
-                full = np.asarray(scheme.encoder.encode_batch(batch))
-                # (B, k+m, block): append shard s's blocks to its file.
-                per_shard = full.transpose(1, 0, 2)
-                for s in range(scheme.total_shards):
-                    per_shard[s].tofile(outs[s])
+                # Contiguous copy: detaches the batch from the memmap so
+                # the device transfer never faults pages mid-flight.
+                yield None, np.ascontiguousarray(batch)
+
+    def write(_meta, batch, parity):
+        # batch (B, k, block) host, parity (B, m, block) from device.
+        for s in range(k):
+            np.ascontiguousarray(batch[:, s, :]).tofile(outs[s])
+        for j in range(parity.shape[1]):
+            np.ascontiguousarray(parity[:, j, :]).tofile(outs[k + j])
+
+    try:
+        pipe.run_pipeline(batches(), scheme.encoder.encode_parity, write)
     finally:
         for f in outs:
             f.close()

@@ -1,0 +1,119 @@
+"""3-stage host→device→host pipeline with double buffering.
+
+SURVEY.md §7 hard part 1: the EC encode targets are bound by host↔device
+transfer, not GF math, so disk reads, H2D+compute, and D2H+disk writes
+must overlap. JAX's async dispatch gives the overlap for free once the
+stages run on separate threads with bounded queues:
+
+- a reader thread materializes host batches (memmap slices → contiguous
+  uint8) and feeds a depth-limited queue;
+- the main thread enqueues ``device_put`` + the jitted encode, which
+  return immediately (device work proceeds in the background);
+- a writer thread calls ``np.asarray`` on the oldest in-flight result —
+  blocking until THAT batch's compute is done while newer batches are
+  still being transferred/computed — and appends to the shard files.
+
+Queue depths of 2 bound host memory at ~4 batches and keep one batch in
+flight on device while the previous drains and the next loads. The same
+loop pipelines the CPU path (reader/writer overlap still helps there).
+
+Reference analog: ec_encoder.go encodeDatFile's sequential
+read→Encode→write loop (SURVEY.md §3.1 hot loop), restructured for an
+accelerator's async queue instead of a synchronous SIMD call.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+#: Stage-queue depth: 2 = classic double buffering.
+DEPTH = 2
+
+_END = object()
+
+
+class PipelineError(RuntimeError):
+    pass
+
+
+def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
+                 encode_fn: Callable[[np.ndarray], Any],
+                 write_fn: Callable[[Any, np.ndarray, np.ndarray], None],
+                 depth: int = DEPTH) -> int:
+    """Drive (meta, host_batch) items through encode_fn with full
+    read/compute/write overlap.
+
+    ``encode_fn(batch)`` must return an asynchronously computed device
+    value (or a host array — the loop still overlaps read and write);
+    ``write_fn(meta, batch, result_np)`` runs on the writer thread in
+    FIFO order, so per-file appends stay ordered. Returns the number of
+    batches processed. Exceptions from any stage propagate."""
+    read_q: queue.Queue = queue.Queue(maxsize=depth)
+    write_q: queue.Queue = queue.Queue(maxsize=depth)
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            for item in batches:
+                if stop.is_set():
+                    return
+                read_q.put(item)
+        except BaseException as e:  # noqa: BLE001 — re-raised in main
+            errors.append(e)
+        finally:
+            read_q.put(_END)
+
+    def writer():
+        try:
+            while True:
+                item = write_q.get()
+                if item is _END:
+                    return
+                meta, batch, result = item
+                write_fn(meta, batch, np.asarray(result))
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+            # Drain so the producer side never blocks on a full queue.
+            while True:
+                if write_q.get() is _END:
+                    return
+
+    rt = threading.Thread(target=reader, name="ec-pipe-read",
+                          daemon=True)
+    wt = threading.Thread(target=writer, name="ec-pipe-write",
+                          daemon=True)
+    rt.start()
+    wt.start()
+    n = 0
+    try:
+        while True:
+            item = read_q.get()
+            if item is _END:
+                break
+            if stop.is_set():
+                continue  # drain reader after writer failure
+            meta, batch = item
+            result = encode_fn(batch)
+            write_q.put((meta, batch, result))
+            n += 1
+    finally:
+        write_q.put(_END)
+        wt.join()
+        stop.set()
+        # Unblock the reader if it is waiting on a full queue.
+        try:
+            while True:
+                read_q.get_nowait()
+        except queue.Empty:
+            pass
+        rt.join()
+    if errors:
+        raise PipelineError(
+            f"pipeline stage failed: {errors[0]!r}") from errors[0]
+    return n

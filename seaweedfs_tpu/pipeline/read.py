@@ -34,9 +34,13 @@ class EcVolumeReader:
     """
 
     def __init__(self, base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
-                 version: Optional[int] = None):
+                 version: Optional[int] = None, aggregator=None):
         self.base = Path(base)
         self.scheme = scheme
+        #: Optional repair.IntervalRepairAggregator: concurrent readers
+        #: share batched device calls instead of issuing one reconstruct
+        #: each (the config-5 repair-under-load path).
+        self.aggregator = aggregator
         ecxp = ec_files.ecx_path(base)
         if not ecxp.exists():
             raise EcReadError(f"{ecxp} does not exist")
@@ -84,9 +88,13 @@ class EcVolumeReader:
             raise TooFewShardsError(
                 f"interval repair needs {self.scheme.data_shards} live "
                 f"shards, found {len(present)}")
-        chunk = np.stack(rows)[None]
-        out = np.asarray(self.scheme.encoder.reconstruct_batch(
-            chunk, present, [shard_id]))[0, 0]
+        if self.aggregator is not None:
+            out = self.aggregator.repair(present, np.stack(rows),
+                                         shard_id)
+        else:
+            chunk = np.stack(rows)[None]
+            out = np.asarray(self.scheme.encoder.reconstruct_batch(
+                chunk, present, [shard_id]))[0, 0]
         self.intervals_repaired += 1
         return out
 
