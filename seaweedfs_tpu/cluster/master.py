@@ -46,7 +46,9 @@ class MasterServer:
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
                  sequencer: Optional[MemorySequencer] = None,
-                 secret: str = "", seed: Optional[int] = None):
+                 secret: str = "", seed: Optional[int] = None,
+                 garbage_threshold: float = 0.3,
+                 garbage_scan_seconds: float = 60.0):
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
@@ -55,6 +57,11 @@ class MasterServer:
             pulse_seconds=pulse_seconds, seed=seed)
         self.sequencer = sequencer or MemorySequencer()
         self.default_replication = default_replication
+        #: Vacuum trigger: deleted/content ratio above which the reap
+        #: loop drives Compact+Commit on the owning server
+        #: (topology_vacuum.go; 0 disables the scan).
+        self.garbage_threshold = garbage_threshold
+        self.garbage_scan_seconds = garbage_scan_seconds
         self.guard = security.Guard(secret)
         self.metrics = Metrics(namespace="master")
         self._channels: dict[str, object] = {}
@@ -62,6 +69,7 @@ class MasterServer:
         self._http_server: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
         self._reaper: Optional[threading.Thread] = None
+        self._vacuum_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._grow_lock = threading.Lock()
 
@@ -113,11 +121,81 @@ class MasterServer:
         self.stop()
 
     def _reap_loop(self) -> None:
+        vacuum_every = max(1, int(self.garbage_scan_seconds /
+                                  max(self.topology.pulse_seconds, 0.01)))
+        tick = 0
         while not self._stop.wait(self.topology.pulse_seconds):
             dead = self.topology.reap_dead_nodes()
             for url in dead:
                 glog.warning("master: data node %s missed heartbeats, "
                              "removed from topology", url)
+            tick += 1
+            if self.garbage_threshold > 0 and tick % vacuum_every == 0 \
+                    and (self._vacuum_thread is None
+                         or not self._vacuum_thread.is_alive()):
+                # Off the reap thread: a long compaction must not stall
+                # dead-node detection.
+                self._vacuum_thread = threading.Thread(
+                    target=self._scan_and_vacuum_safe, daemon=True,
+                    name="master-vacuum-scan")
+                self._vacuum_thread.start()
+
+    def _scan_and_vacuum_safe(self) -> None:
+        try:
+            self.scan_and_vacuum()
+        except Exception as e:  # noqa: BLE001 — keep the scan cadence up
+            glog.warning("master: vacuum scan failed: %s", e)
+
+    def scan_and_vacuum(self, threshold: Optional[float] = None) -> int:
+        """topology_vacuum.go analog: walk every volume, and when a
+        node-reported garbage ratio exceeds the threshold, drive the
+        Check → Compact → Commit rpc sequence on its server. Returns the
+        number of volumes vacuumed."""
+        threshold = self.garbage_threshold if threshold is None \
+            else threshold
+        done = 0
+        for node in self.topology.snapshot_nodes():
+            for v in list(node.volumes.values()):
+                if v.size <= 8 or v.read_only:
+                    continue
+                if v.deleted_byte_count / max(1, v.size - 8) <= threshold:
+                    continue
+                # Per-volume isolation: one failing volume/server must
+                # not starve the rest of the scan.
+                try:
+                    done += self._vacuum_one(node.url, v, threshold)
+                except Exception as e:  # noqa: BLE001
+                    glog.warning(
+                        "master: vacuum of volume %d on %s failed: %s",
+                        v.id, node.url, e)
+        return done
+
+    def _vacuum_one(self, node_url: str, v, threshold: float) -> int:
+        stub = self._volume_stub(node_url)
+        check = stub.VacuumVolumeCheck(
+            volume_server_pb2.VacuumVolumeCheckRequest(
+                volume_id=v.id, collection=v.collection))
+        if check.garbage_ratio <= threshold:
+            return 0
+        glog.info("master: vacuuming volume %d on %s (garbage %.0f%%)",
+                  v.id, node_url, check.garbage_ratio * 100)
+        try:
+            stub.VacuumVolumeCompact(
+                volume_server_pb2.VacuumVolumeCompactRequest(
+                    volume_id=v.id, collection=v.collection))
+            stub.VacuumVolumeCommit(
+                volume_server_pb2.VacuumVolumeCommitRequest(
+                    volume_id=v.id, collection=v.collection))
+            return 1
+        except Exception:
+            try:
+                stub.VacuumVolumeCleanup(
+                    volume_server_pb2.VacuumVolumeCleanupRequest(
+                        volume_id=v.id, collection=v.collection))
+            except Exception as ce:  # noqa: BLE001 — keep original error
+                glog.warning("master: vacuum cleanup of volume %d on %s "
+                             "also failed: %s", v.id, node_url, ce)
+            raise
 
     # ------------- volume-server dialing -------------
 

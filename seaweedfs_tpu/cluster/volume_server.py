@@ -195,6 +195,7 @@ class VolumeServer:
                 id=v["id"], collection=v["collection"], size=v["size"],
                 file_count=v["file_count"],
                 delete_count=v.get("deleted_count", 0),
+                deleted_byte_count=v.get("deleted_bytes", 0),
                 read_only=v["read_only"],
                 replica_placement=ReplicaPlacement.parse(
                     v["replica_placement"]).to_byte(),
@@ -312,6 +313,9 @@ class _VolumeServicer:
 
     def __init__(self, vs: VolumeServer):
         self.vs = vs
+        # (collection, vid) -> vacuum.CompactState between the Compact
+        # and Commit rpcs of a vacuum.
+        self._compact_states: dict[tuple[str, int], object] = {}
 
     # ---- volume admin ----
 
@@ -333,6 +337,47 @@ class _VolumeServicer:
     def VolumeMarkWritable(self, request, context):
         self.vs.store.mark_writable(request.volume_id, request.collection)
         return volume_server_pb2.VolumeMarkWritableResponse()
+
+    # -- vacuum family (volume_grpc_vacuum.go analogs) ------------------
+
+    def VacuumVolumeCheck(self, request, context):
+        return volume_server_pb2.VacuumVolumeCheckResponse(
+            garbage_ratio=self.vs.store.garbage_ratio(
+                request.volume_id, request.collection))
+
+    def VacuumVolumeCompact(self, request, context):
+        store = self.vs.store
+        vol = store.get_volume(request.volume_id, request.collection)
+        from ..storage import vacuum as vacuum_mod
+
+        self._compact_states[(request.collection, request.volume_id)] = \
+            vacuum_mod.compact(vol)
+        return volume_server_pb2.VacuumVolumeCompactResponse()
+
+    def VacuumVolumeCommit(self, request, context):
+        from ..storage import vacuum as vacuum_mod
+
+        key = (request.collection, request.volume_id)
+        state = self._compact_states.pop(key, None)
+        if state is None:
+            raise VolumeServerError(
+                f"no compact in progress for volume {request.volume_id}")
+        vol = self.vs.store.get_volume(request.volume_id,
+                                       request.collection)
+        size = vacuum_mod.commit_compact(vol, state)
+        self.vs.heartbeat_now()
+        return volume_server_pb2.VacuumVolumeCommitResponse(
+            volume_size=size)
+
+    def VacuumVolumeCleanup(self, request, context):
+        from ..storage import vacuum as vacuum_mod
+
+        key = (request.collection, request.volume_id)
+        self._compact_states.pop(key, None)
+        vol = self.vs.store.get_volume(request.volume_id,
+                                       request.collection)
+        vacuum_mod.abort_compact(vol)
+        return volume_server_pb2.VacuumVolumeCleanupResponse()
 
     def VolumeStatus(self, request, context):
         resp = volume_server_pb2.VolumeStatusResponse()

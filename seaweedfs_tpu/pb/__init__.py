@@ -90,6 +90,18 @@ VOLUME_METHODS = [
     Method("VolumeEcBlobDelete",
            volume_server_pb2.VolumeEcBlobDeleteRequest,
            volume_server_pb2.VolumeEcBlobDeleteResponse),
+    Method("VacuumVolumeCheck",
+           volume_server_pb2.VacuumVolumeCheckRequest,
+           volume_server_pb2.VacuumVolumeCheckResponse),
+    Method("VacuumVolumeCompact",
+           volume_server_pb2.VacuumVolumeCompactRequest,
+           volume_server_pb2.VacuumVolumeCompactResponse),
+    Method("VacuumVolumeCommit",
+           volume_server_pb2.VacuumVolumeCommitRequest,
+           volume_server_pb2.VacuumVolumeCommitResponse),
+    Method("VacuumVolumeCleanup",
+           volume_server_pb2.VacuumVolumeCleanupRequest,
+           volume_server_pb2.VacuumVolumeCleanupResponse),
 ]
 
 

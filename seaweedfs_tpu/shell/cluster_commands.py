@@ -367,6 +367,55 @@ def cmd_volume_list(env: ClusterEnv, argv: list[str]) -> None:
                         f"shards={ShardBits(s.ec_index_bits).ids()}")
 
 
+@cluster_command("volume.vacuum")
+def cmd_volume_vacuum(env: ClusterEnv, argv: list[str]) -> None:
+    """Drive Check -> Compact -> Commit on every volume whose reported
+    garbage ratio exceeds the threshold (command_volume_vacuum.go /
+    topology_vacuum.go choreography, operator-triggered)."""
+    p = _parser("volume.vacuum")
+    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-collection", default="")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    args = p.parse_args(argv)
+    resp = env.volume_list()
+    vacuumed = 0
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for v in dn.volume_infos:
+                    if args.volumeId and v.id != args.volumeId:
+                        continue
+                    if args.collection and \
+                            v.collection != args.collection:
+                        continue
+                    stub = env.volume(dn.id)
+                    check = stub.VacuumVolumeCheck(
+                        volume_server_pb2.VacuumVolumeCheckRequest(
+                            volume_id=v.id, collection=v.collection))
+                    threshold = 0.0 if args.volumeId else \
+                        args.garbageThreshold
+                    if check.garbage_ratio <= threshold:
+                        continue
+                    try:
+                        stub.VacuumVolumeCompact(
+                            volume_server_pb2.VacuumVolumeCompactRequest(
+                                volume_id=v.id, collection=v.collection))
+                        done = stub.VacuumVolumeCommit(
+                            volume_server_pb2.VacuumVolumeCommitRequest(
+                                volume_id=v.id, collection=v.collection))
+                    except Exception:
+                        stub.VacuumVolumeCleanup(
+                            volume_server_pb2.VacuumVolumeCleanupRequest(
+                                volume_id=v.id, collection=v.collection))
+                        raise
+                    env.println(
+                        f"volume.vacuum: volume {v.id} on {dn.id} "
+                        f"garbage {check.garbage_ratio:.1%} -> "
+                        f"{done.volume_size} bytes")
+                    vacuumed += 1
+    env.println(f"volume.vacuum: {vacuumed} volumes compacted")
+
+
 @cluster_command("volume.balance")
 def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
     """Move whole volumes from loaded to free servers

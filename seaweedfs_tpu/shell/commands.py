@@ -261,6 +261,31 @@ def cmd_volume_list(env: CommandEnv, argv: list[str]) -> None:
         env.println("no volumes")
 
 
+@command("volume.vacuum")
+def cmd_volume_vacuum(env: CommandEnv, argv: list[str]) -> None:
+    """Compact away deleted needles (volume_vacuum.go Compact +
+    CommitCompact), reclaiming the space delete tombstones only mark."""
+    p = _parser("volume.vacuum")
+    p.add_argument("-volumeId", type=int, default=0,
+                   help="one volume (default: all above threshold)")
+    p.add_argument("-collection", default="")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    args = p.parse_args(argv)
+    targets = [(args.collection, args.volumeId)] if args.volumeId else \
+        sorted(k for k in env.store.volumes
+               if not args.collection or k[0] == args.collection)
+    for col, vid in targets:
+        ratio = env.store.garbage_ratio(vid, col)
+        threshold = 0.0 if args.volumeId else args.garbageThreshold
+        new_size = env.store.vacuum_volume(vid, col, threshold)
+        if new_size is None:
+            env.println(f"volume.vacuum {vid}: garbage {ratio:.1%} "
+                        f"below threshold, skipped")
+        else:
+            env.println(f"volume.vacuum {vid}: garbage {ratio:.1%} "
+                        f"reclaimed, now {new_size} bytes")
+
+
 @command("volume.delete")
 def cmd_volume_delete(env: CommandEnv, argv: list[str]) -> None:
     p = _parser("volume.delete")

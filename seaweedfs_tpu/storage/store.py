@@ -194,6 +194,23 @@ class Store:
             if p.exists():
                 p.unlink()
 
+    # -- vacuum -----------------------------------------------------------
+
+    def garbage_ratio(self, volume_id: int, collection: str = ""
+                      ) -> float:
+        from . import vacuum as vacuum_mod
+        return vacuum_mod.garbage_ratio(
+            self.get_volume(volume_id, collection))
+
+    def vacuum_volume(self, volume_id: int, collection: str = "",
+                      threshold: float = 0.0):
+        """Compact away deleted needles when garbage exceeds
+        ``threshold`` (volume_vacuum.go Compact + CommitCompact).
+        Returns the new .dat size, or None when below threshold."""
+        from . import vacuum as vacuum_mod
+        return vacuum_mod.vacuum(self.get_volume(volume_id, collection),
+                                 threshold)
+
     # -- data plane -------------------------------------------------------
 
     def write_needle(self, volume_id: int, n: Needle,
@@ -323,6 +340,7 @@ class Store:
                 "id": vid, "collection": col,
                 "size": v.dat_size, "file_count": v.nm.file_count,
                 "deleted_count": v.nm.deleted_count,
+                "deleted_bytes": v.nm.deleted_bytes,
                 "read_only": (col, vid) in self.readonly,
                 "replica_placement": str(v.super_block.replica_placement),
                 "version": v.super_block.version,

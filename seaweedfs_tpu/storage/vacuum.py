@@ -1,0 +1,223 @@
+"""Volume vacuum: compact away deleted needles, then atomically commit.
+
+Mirrors weed/storage/volume_vacuum.go (SURVEY.md §2 "Store / Volume
+engine": ``Compact`` / ``CommitCompact``): deletes only journal
+tombstones, so reclaimed space accumulates until a compaction rewrites
+the live needles into a fresh ``.cpd``/``.cpx`` pair and renames them
+over ``.dat``/``.idx``.
+
+Two phases, same as the reference:
+
+- ``compact(vol)`` — snapshot the .idx length, then copy every needle
+  live AS OF the snapshot into ``.cpd`` (superblock compact revision
+  +1) while writes keep landing in the old files. Uses pread, so no
+  writer lock is held during the bulk copy.
+- ``commit_compact(vol)`` — under the volume lock, replay .idx entries
+  journaled AFTER the snapshot onto the compact files (the reference's
+  ``makeupDiff``), fsync, rename into place, and reload the needle map.
+
+Crash safety: a crash before the final renames leaves ``.cpd``/``.cpx``
+behind and the live volume untouched — ``cleanup`` (or the next load)
+just deletes them. The rename pair is ordered .idx-last so a torn
+commit is detected by load-time checking.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from . import needle as needle_mod
+from .idx import CompactMap, IndexEntry, walk_index_blob
+from .superblock import SuperBlock
+from .types import (NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE,
+                    actual_offset, to_offset_units)
+from .volume import Volume, VolumeError, dat_path, idx_path
+
+
+def cpd_path(base: str | Path) -> Path:
+    return Path(str(base) + ".cpd")
+
+
+def cpx_path(base: str | Path) -> Path:
+    return Path(str(base) + ".cpx")
+
+
+@dataclass
+class CompactState:
+    """Carried from compact() to commit_compact()."""
+    idx_snapshot_bytes: int
+    new_super: SuperBlock
+
+
+def garbage_ratio(vol: Volume) -> float:
+    """Deleted bytes / content bytes (topology_vacuum.go's trigger)."""
+    size = vol.dat_size
+    if size <= 8:
+        return 0.0
+    return vol.nm.deleted_bytes / max(1, size - 8)
+
+
+def compact(vol: Volume) -> CompactState:
+    """Phase 1: copy live needles to .cpd/.cpx. Writers may continue.
+
+    At most one compaction per volume may be in flight: a second
+    compact() (e.g. the master's auto-scan racing an operator's
+    volume.vacuum) raises instead of interleaving writes into the same
+    .cpd and letting one Commit rename a half-written file live."""
+    if vol._dat is None:
+        raise VolumeError("volume not open")
+    with vol._lock:
+        if getattr(vol, "vacuum_in_progress", False):
+            raise VolumeError(
+                f"volume {vol.volume_id}: compaction already in progress")
+        vol.vacuum_in_progress = True
+    try:
+        return _compact_locked(vol)
+    except BaseException:
+        vol.vacuum_in_progress = False
+        cleanup(vol.base)
+        raise
+
+
+def _compact_locked(vol: Volume) -> CompactState:
+    with vol._lock:
+        vol._idx.flush()
+        vol._dat.flush()
+        idx_snapshot = idx_path(vol.base).stat().st_size
+        idx_snapshot -= idx_snapshot % 16
+    # Needle map as of the snapshot (not vol.nm, which keeps moving).
+    snap = CompactMap()
+    with open(idx_path(vol.base), "rb") as f:
+        for e in walk_index_blob(f.read(idx_snapshot)):
+            if e.is_deleted:
+                snap.delete(e.key)
+            else:
+                snap.set(e.key, e.offset_units, e.size)
+    new_super = SuperBlock(
+        version=vol.super_block.version,
+        replica_placement=vol.super_block.replica_placement,
+        ttl=vol.super_block.ttl,
+        compact_revision=(vol.super_block.compact_revision + 1) & 0xFFFF)
+    dat_fd = vol._dat.fileno()
+    with open(cpd_path(vol.base), "wb") as nd, \
+            open(cpx_path(vol.base), "wb") as nx:
+        nd.write(new_super.to_bytes())
+        _copy_live(snap, dat_fd, vol.super_block.version, nd, nx)
+        nd.flush()
+        os.fsync(nd.fileno())
+        nx.flush()
+        os.fsync(nx.fileno())
+    return CompactState(idx_snapshot_bytes=idx_snapshot,
+                        new_super=new_super)
+
+
+def _copy_live(snap: CompactMap, dat_fd: int, version: int, nd, nx
+               ) -> None:
+    """Append every live needle of ``snap`` to nd/.cpx in offset order
+    (preserves locality and keeps the copy sequential on disk)."""
+    entries = sorted(
+        (e for e in snap._m.values() if not e.is_deleted),
+        key=lambda e: e.offset_units)
+    for e in entries:
+        rec_size = needle_mod.record_size(e.size, version)
+        rec = os.pread(dat_fd, rec_size, e.byte_offset)
+        if len(rec) < rec_size:
+            raise VolumeError(
+                f"short read compacting needle {e.key}")
+        pos = nd.tell()
+        if pos % NEEDLE_PADDING_SIZE:
+            pad = (-pos) % NEEDLE_PADDING_SIZE
+            nd.write(b"\x00" * pad)
+            pos += pad
+        nd.write(rec)
+        nx.write(IndexEntry(e.key, to_offset_units(pos),
+                            e.size).to_bytes())
+
+
+def commit_compact(vol: Volume, state: CompactState) -> int:
+    """Phase 2: catch up post-snapshot writes, swap files, reload.
+    Returns the new .dat size."""
+    if vol._dat is None:
+        raise VolumeError("volume not open")
+    if not getattr(vol, "vacuum_in_progress", False):
+        raise VolumeError(
+            f"volume {vol.volume_id}: no compaction in progress")
+    with vol._lock:
+        vol._idx.flush()
+        vol._dat.flush()
+        idx_now = idx_path(vol.base).stat().st_size
+        idx_now -= idx_now % 16
+        with open(cpd_path(vol.base), "r+b") as nd, \
+                open(cpx_path(vol.base), "r+b") as nx:
+            nd.seek(0, 2)
+            nx.seek(0, 2)
+            # Replay the diff journal (makeupDiff): appends copy the
+            # record across, deletes tombstone the compact index.
+            if idx_now > state.idx_snapshot_bytes:
+                with open(idx_path(vol.base), "rb") as f:
+                    f.seek(state.idx_snapshot_bytes)
+                    diff = f.read(idx_now - state.idx_snapshot_bytes)
+                dat_fd = vol._dat.fileno()
+                for e in walk_index_blob(diff):
+                    if e.is_deleted:
+                        nx.write(IndexEntry(
+                            e.key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
+                        continue
+                    rec_size = needle_mod.record_size(
+                        e.size, vol.super_block.version)
+                    rec = os.pread(dat_fd, rec_size, e.byte_offset)
+                    pos = nd.tell()
+                    if pos % NEEDLE_PADDING_SIZE:
+                        pad = (-pos) % NEEDLE_PADDING_SIZE
+                        nd.write(b"\x00" * pad)
+                        pos += pad
+                    nd.write(rec)
+                    nx.write(IndexEntry(e.key, to_offset_units(pos),
+                                        e.size).to_bytes())
+            nd.flush()
+            os.fsync(nd.fileno())
+            nx.flush()
+            os.fsync(nx.fileno())
+        # Swap: close handles, rename .cpd/.cpx over .dat/.idx (dat
+        # first; load-time checking tolerates a torn pair), reopen.
+        vol._dat.close()
+        vol._idx.close()
+        os.replace(cpd_path(vol.base), dat_path(vol.base))
+        os.replace(cpx_path(vol.base), idx_path(vol.base))
+        vol._dat = open(dat_path(vol.base), "r+b")
+        vol._idx = open(idx_path(vol.base), "a+b")
+        vol.super_block = state.new_super
+        vol.nm = CompactMap.load_from_idx(idx_path(vol.base))
+        vol._dat.seek(0, 2)
+        vol.vacuum_in_progress = False
+        return vol._dat.tell()
+
+
+def cleanup(base: str | Path) -> None:
+    """Remove leftover compact files (crash before commit)."""
+    for p in (cpd_path(base), cpx_path(base)):
+        if p.exists():
+            p.unlink()
+
+
+def abort_compact(vol: Volume) -> None:
+    """Drop an in-flight compaction: delete its files, clear the
+    in-progress flag (the VacuumVolumeCleanup rpc)."""
+    cleanup(vol.base)
+    vol.vacuum_in_progress = False
+
+
+def vacuum(vol: Volume, threshold: float = 0.0) -> Optional[int]:
+    """Compact + commit when garbage_ratio exceeds ``threshold``.
+    Returns the new size, or None when below threshold."""
+    if garbage_ratio(vol) <= threshold:
+        return None
+    state = compact(vol)
+    try:
+        return commit_compact(vol, state)
+    except BaseException:
+        abort_compact(vol)
+        raise

@@ -23,9 +23,10 @@ from typing import Optional
 import numpy as np
 
 from . import needle as needle_mod
-from .idx import CompactMap, IndexEntry
+from .idx import CompactMap, IndexEntry, walk_index_blob
 from .superblock import SuperBlock
-from .types import (NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE,
+from .types import (NEEDLE_HEADER_SIZE, NEEDLE_MAP_ENTRY_SIZE,
+                    NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE,
                     to_offset_units)
 
 
@@ -53,6 +54,8 @@ class Volume:
         self.nm = CompactMap()
         self._dat = None
         self._idx = None
+        #: Guard: at most one compaction in flight (storage/vacuum.py).
+        self.vacuum_in_progress = False
         # Appends mutate shared file-handle state; reads use os.pread on
         # the raw fd, so only writers serialize (volume server threads
         # hit one Volume concurrently).
@@ -72,12 +75,30 @@ class Volume:
         p = dat_path(self.base)
         if not p.exists():
             raise VolumeError(f"{p} does not exist")
+        # Compaction crash recovery. States (commit renames .cpd over
+        # .dat FIRST, then .cpx over .idx):
+        #   .cpd + .cpx  -> crash before commit: live volume untouched,
+        #                   drop both.
+        #   .cpx only    -> crash BETWEEN the renames: the .dat is
+        #                   already the compacted one and the old .idx
+        #                   points at stale offsets — the .cpx is the
+        #                   only correct index, so FINISH the commit.
+        #   .cpd only    -> crash mid-compact before .cpx existed: drop.
+        cpd = Path(str(self.base) + ".cpd")
+        cpx = Path(str(self.base) + ".cpx")
+        if cpx.exists() and not cpd.exists():
+            os.replace(cpx, idx_path(self.base))
+        else:
+            for leftover in (cpd, cpx):
+                if leftover.exists():
+                    leftover.unlink()
         self._dat = open(p, "r+b")
         head = self._dat.read(8)
         if len(head) < 8:
             raise VolumeError(f"{p} shorter than a superblock")
         extra_len = struct.unpack_from(">H", head, 6)[0]
         self.super_block = SuperBlock.parse(head + self._dat.read(extra_len))
+        check_volume_data_integrity(self.base, self.super_block)
         ip = idx_path(self.base)
         self._idx = open(ip, "a+b") if ip.exists() else open(ip, "w+b")
         self.nm = CompactMap.load_from_idx(ip)
@@ -165,6 +186,84 @@ class Volume:
 
     def content_size(self) -> int:
         return self.dat_size
+
+
+def check_volume_data_integrity(base: str | Path,
+                                super_block: SuperBlock) -> dict:
+    """Crash-recovery tail verification, run on every load.
+
+    The reference's volume_checking.go verifies the LAST index entry's
+    needle and refuses the volume on mismatch; here torn tails are
+    REPAIRED instead (the write order is dat-then-idx, so the tail is
+    always the casualty): a partial trailing .idx entry is truncated, a
+    trailing .idx entry whose record is missing/short/mismatched in the
+    .dat is dropped, and .dat bytes past the last journaled record (a
+    torn append that never reached the index) are truncated. Returns a
+    dict of repairs performed (empty = clean)."""
+    repairs: dict[str, int] = {}
+    ip, dp = idx_path(base), dat_path(base)
+    dat_size = dp.stat().st_size
+    version = super_block.version
+    if not ip.exists():
+        return repairs
+    blob = ip.read_bytes()  # one read serves every pass below
+    idx_size = len(blob)
+    if idx_size % NEEDLE_MAP_ENTRY_SIZE:
+        idx_size -= idx_size % NEEDLE_MAP_ENTRY_SIZE
+        repairs["idx_partial_entry"] = 1
+    # Back-walk the trailing entries. Tombstones reference no .dat bytes
+    # so they can't be validated — step over them and keep checking the
+    # entries beneath (a torn record under a trailing delete must still
+    # be caught). If any entry proves invalid, truncate at that entry:
+    # everything journaled after it belongs to the same un-acknowledged
+    # crash window.
+    dat_fd = os.open(dp, os.O_RDONLY)
+    try:
+        truncate_to = idx_size
+        pos = idx_size
+        while pos >= NEEDLE_MAP_ENTRY_SIZE:
+            e = IndexEntry.from_bytes(blob, pos - NEEDLE_MAP_ENTRY_SIZE)
+            if e.is_deleted:
+                pos -= NEEDLE_MAP_ENTRY_SIZE
+                continue
+            end = e.byte_offset + needle_mod.record_size(e.size, version)
+            ok = False
+            if end <= dat_size:
+                hdr = os.pread(dat_fd, NEEDLE_HEADER_SIZE, e.byte_offset)
+                try:
+                    _, nid, nsize = needle_mod.parse_header(hdr)
+                    ok = nid == e.key and nsize == e.size
+                except needle_mod.NeedleError:
+                    ok = False
+            if ok:
+                break
+            pos -= NEEDLE_MAP_ENTRY_SIZE
+            truncate_to = pos
+    finally:
+        os.close(dat_fd)
+    if truncate_to < idx_size:
+        repairs["idx_dropped_entries"] = \
+            (idx_size - truncate_to) // NEEDLE_MAP_ENTRY_SIZE
+        idx_size = truncate_to
+    if idx_size < len(blob):
+        blob = blob[:idx_size]
+        with open(ip, "r+b") as f:
+            f.truncate(idx_size)
+    # The true append frontier is the max record end over every
+    # journaled (non-tombstone) entry — deleted needles' bytes are still
+    # in the file; anything beyond is a torn append.
+    frontier = super_block.block_size
+    for e in walk_index_blob(blob):
+        if e.is_deleted:
+            continue
+        frontier = max(
+            frontier,
+            e.byte_offset + needle_mod.record_size(e.size, version))
+    if dat_size > frontier:
+        with open(dp, "r+b") as df:
+            df.truncate(frontier)
+        repairs["dat_truncated_bytes"] = dat_size - frontier
+    return repairs
 
 
 def generate_synthetic_volume(base: str | Path, volume_id: int,

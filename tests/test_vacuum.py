@@ -1,0 +1,277 @@
+"""Vacuum/compaction + load-time crash recovery (volume_vacuum.go,
+volume_checking.go analogs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.idx import IndexEntry
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import (Volume, dat_path,
+                                          generate_synthetic_volume,
+                                          idx_path)
+
+
+def _fill(base, n=40, seed=0):
+    vol = generate_synthetic_volume(base, 1, n_needles=n, seed=seed)
+    payloads = {}
+    for i in range(1, n + 1):
+        payloads[i] = vol.read_needle(i).data
+    return vol, payloads
+
+
+def test_vacuum_reclaims_space_and_preserves_reads(tmp_path):
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base)
+    before = vol.dat_size
+    deleted = list(range(1, 41, 2))  # every odd needle
+    for k in deleted:
+        assert vol.delete_needle(k)
+    assert vacuum_mod.garbage_ratio(vol) > 0.3
+    new_size = vacuum_mod.vacuum(vol, threshold=0.3)
+    assert new_size is not None and new_size < before
+    assert vol.super_block.compact_revision == 1
+    assert vacuum_mod.garbage_ratio(vol) == 0.0
+    for k, data in payloads.items():
+        if k in deleted:
+            with pytest.raises(KeyError):
+                vol.read_needle(k)
+        else:
+            assert vol.read_needle(k).data == data
+    # idx shrank too (tombstones gone)
+    assert idx_path(base).stat().st_size == 16 * 20
+    # a reloaded volume sees the same state
+    vol.close()
+    v2 = Volume(base, 1).load()
+    assert v2.super_block.compact_revision == 1
+    for k in range(2, 41, 2):
+        assert v2.read_needle(k).data == payloads[k]
+    v2.close()
+
+
+def test_vacuum_below_threshold_is_noop(tmp_path):
+    base = str(tmp_path / "1")
+    vol, _ = _fill(base, n=20)
+    vol.delete_needle(1)
+    assert vacuum_mod.vacuum(vol, threshold=0.9) is None
+    assert vol.super_block.compact_revision == 0
+    vol.close()
+
+
+def test_commit_catches_up_writes_after_snapshot(tmp_path):
+    """Writes and deletes landing between compact() and
+    commit_compact() must survive (the makeupDiff path)."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=10)
+    for k in (1, 2, 3):
+        vol.delete_needle(k)
+    state = vacuum_mod.compact(vol)
+    # post-snapshot activity
+    vol.write_needle(Needle(cookie=7, id=100, data=b"late-write"))
+    vol.delete_needle(4)
+    vacuum_mod.commit_compact(vol, state)
+    assert vol.read_needle(100).data == b"late-write"
+    with pytest.raises(KeyError):
+        vol.read_needle(4)
+    for k in range(5, 11):
+        assert vol.read_needle(k).data == payloads[k]
+    vol.close()
+    v2 = Volume(base, 1).load()
+    assert v2.read_needle(100).data == b"late-write"
+    v2.close()
+
+
+def test_crash_before_commit_leaves_volume_intact(tmp_path):
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=10)
+    vol.delete_needle(1)
+    vacuum_mod.compact(vol)  # state dropped = crash before commit
+    vol.close()
+    assert vacuum_mod.cpd_path(base).exists()
+    v2 = Volume(base, 1).load()  # load cleans leftovers
+    assert not vacuum_mod.cpd_path(base).exists()
+    assert not vacuum_mod.cpx_path(base).exists()
+    for k in range(2, 11):
+        assert v2.read_needle(k).data == payloads[k]
+    v2.close()
+
+
+# -- load-time tail checking ------------------------------------------
+
+
+def test_load_truncates_torn_dat_tail(tmp_path):
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=10)
+    good_size = vol.dat_size
+    vol.close()
+    with open(dat_path(base), "ab") as f:
+        f.write(b"\x13" * 37)  # torn append, never indexed
+    v2 = Volume(base, 1).load()
+    assert v2.dat_size == good_size
+    for k, data in payloads.items():
+        assert v2.read_needle(k).data == data
+    v2.close()
+
+
+def test_load_truncates_partial_idx_entry(tmp_path):
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=5)
+    vol.close()
+    with open(idx_path(base), "ab") as f:
+        f.write(b"\x01" * 9)  # torn 16-byte entry
+    v2 = Volume(base, 1).load()
+    assert idx_path(base).stat().st_size % 16 == 0
+    assert len(v2.nm) == 5
+    v2.close()
+
+
+def test_load_drops_idx_entry_without_dat_record(tmp_path):
+    """An index entry whose record never made it to the .dat (or was
+    torn) is dropped on load instead of serving garbage."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=5)
+    dat_end = vol.dat_size
+    vol.close()
+    with open(idx_path(base), "ab") as f:
+        f.write(IndexEntry(999, dat_end // 8, 1234).to_bytes())
+    v2 = Volume(base, 1).load()
+    assert v2.nm.get(999) is None
+    for k, data in payloads.items():
+        assert v2.read_needle(k).data == data
+    v2.close()
+
+
+def test_store_vacuum_and_grpc(tmp_path):
+    """Store facade + the gRPC Check/Compact/Commit handlers."""
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store([tmp_path], max_volumes=4)
+    store.create_volume(3)
+    rng = np.random.default_rng(0)
+    for i in range(1, 31):
+        store.write_needle(3, Needle(
+            cookie=1, id=i,
+            data=rng.integers(0, 256, 500, dtype=np.uint8).tobytes()))
+    for i in range(1, 16):
+        store.delete_needle(3, i)
+    assert store.garbage_ratio(3) > 0.3
+    assert store.vacuum_volume(3, threshold=0.3) is not None
+    assert store.garbage_ratio(3) == 0.0
+    assert store.read_needle(3, 20).data is not None
+    store.close()
+
+
+def test_cluster_vacuum_via_shell_and_master_scan(tmp_path):
+    """gRPC Check/Compact/Commit through the cluster shell command, and
+    the master's topology garbage scan driving the same rpcs."""
+    import io
+    import time
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.cluster_commands import (
+        ClusterEnv, run_cluster_command)
+    from seaweedfs_tpu.storage.store import Store
+
+    from test_cluster_integration import _free_port_pair
+
+    master = MasterServer(port=_free_port_pair(),
+                          volume_size_limit_mb=64,
+                          pulse_seconds=0.2, seed=5).start()
+    (tmp_path / "v").mkdir()
+    store = Store([tmp_path / "v"], max_volumes=4)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=0.2).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topology.nodes:
+            time.sleep(0.05)
+        store.create_volume(7)
+        rng = np.random.default_rng(1)
+        for i in range(1, 41):
+            store.write_needle(7, Needle(
+                cookie=2, id=i, data=rng.integers(
+                    0, 256, 800, dtype=np.uint8).tobytes()))
+        for i in range(1, 31):
+            store.delete_needle(7, i)
+        before = store.get_volume(7).dat_size
+        vs.heartbeat_now()
+        time.sleep(0.1)
+
+        out = io.StringIO()
+        env = ClusterEnv(master_url=master.url, out=out)
+        run_cluster_command(env, "volume.vacuum -garbageThreshold 0.3")
+        assert "volume 7" in out.getvalue(), out.getvalue()
+        assert store.get_volume(7).dat_size < before
+        assert store.read_needle(7, 35).data is not None
+        env.close()
+
+        # master scan path: create fresh garbage, let scan pick it up
+        for i in range(31, 39):
+            store.delete_needle(7, i)
+        vs.heartbeat_now()
+        time.sleep(0.1)
+        assert master.scan_and_vacuum(threshold=0.3) == 1
+        assert store.garbage_ratio(7) == 0.0
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_torn_commit_between_renames_recovers(tmp_path):
+    """Crash AFTER .cpd->.dat but BEFORE .cpx->.idx: load must finish
+    the commit (the .cpx is the only index matching the new .dat)."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=20)
+    for k in range(1, 11):
+        vol.delete_needle(k)
+    state = vacuum_mod.compact(vol)
+    vol.close()
+    # simulate the torn commit by hand
+    os.replace(vacuum_mod.cpd_path(base), dat_path(base))
+    assert vacuum_mod.cpx_path(base).exists()
+    v2 = Volume(base, 1).load()
+    assert not vacuum_mod.cpx_path(base).exists()
+    assert v2.super_block.compact_revision == 1
+    for k in range(11, 21):
+        assert v2.read_needle(k).data == payloads[k]
+    for k in range(1, 11):
+        with pytest.raises(KeyError):
+            v2.read_needle(k)
+    v2.close()
+
+
+def test_torn_record_under_trailing_tombstone(tmp_path):
+    """A torn .dat record must be caught even when a tombstone was
+    journaled after it (back-walk steps over tombstones)."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=5)
+    torn_off = vol.dat_size
+    vol.write_needle(Needle(cookie=9, id=50, data=b"will be torn"))
+    vol.delete_needle(2)  # tombstone lands after needle 50's entry
+    vol.close()
+    with open(dat_path(base), "r+b") as f:
+        f.truncate(torn_off + 4)  # tear needle 50's record
+    v2 = Volume(base, 1).load()
+    assert v2.nm.get(50) is None, "torn record served"
+    for k in (1, 3, 4, 5):
+        assert v2.read_needle(k).data == payloads[k]
+    v2.close()
+
+
+def test_concurrent_compact_rejected(tmp_path):
+    from seaweedfs_tpu.storage.volume import VolumeError
+
+    base = str(tmp_path / "1")
+    vol, _ = _fill(base, n=10)
+    vol.delete_needle(1)
+    state = vacuum_mod.compact(vol)
+    with pytest.raises(VolumeError, match="in progress"):
+        vacuum_mod.compact(vol)
+    vacuum_mod.commit_compact(vol, state)
+    # after commit a new cycle is allowed again
+    vol.delete_needle(2)
+    assert vacuum_mod.vacuum(vol, threshold=0.0) is not None
+    vol.close()
