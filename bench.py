@@ -8,13 +8,30 @@ RS(10,4) encode per chip (BASELINE.json north star). Sub-metrics (rebuild,
 end-to-end file path, alternate geometries, CPU baseline) ride in the same
 JSON under ``extras`` and are echoed to stderr.
 
-Hardened against a hung/unavailable TPU tunnel (the axon PJRT plugin can
-hang at first backend init): the parent process imports NO jax. It probes
-the backend in a subprocess with a watchdog + retry; on persistent failure
-it re-runs the benchmark in a scrubbed-environment CPU subprocess
-(PYTHONPATH without the sitecustomize hook, JAX_PLATFORMS=cpu) and STILL
-prints the one-line JSON with ``"platform": "cpu", "degraded": true``.
-This process never exits nonzero.
+Measurement honesty (see PERF.md):
+* The headline streams ~1 GiB through repeated (1, 10, slab) device calls
+  — never one giant ``pallas_call`` (a 1 GiB single call demands a ~55 GB
+  padded HBM layout and cannot compile; slabs of <= 160 MiB input do).
+  On compile failure the slab auto-shrinks (halves) and retries.
+* Every timed loop XOR-accumulates a checksum of each output ON DEVICE and
+  fetches the checksum bytes to host at the end of the window — the clock
+  stops only when real result bytes reached the host, so an early-return
+  ``block_until_ready`` cannot fake the number. Distinct input buffers are
+  used across calls so no result can be cached.
+* Device-resident (compute-only) and host->device->host (end-to-end) are
+  measured separately; the e2e number is the PCIe/tunnel-bound figure
+  SURVEY.md §7 hard-part-1 predicts.
+* A real-device correctness smoke (encode + 2-shard reconstruct vs the
+  NumPy oracle) gates the headline: if the kernel is wrong on the actual
+  backend, the child aborts rather than report a throughput.
+
+Robustness against the intermittent axon TPU tunnel (can hang at backend
+init): the parent imports NO jax. Sub-benches run in SEPARATE watchdogged
+children (core / config3 / config5) and append partial results to
+``artifacts/BENCH_partial_r04.jsonl`` as they complete, so a hang in one
+stage costs only that stage. The parent re-probes between stages and
+falls back per-stage to a scrubbed CPU environment; the final JSON is a
+merge, with per-stage platform markers. This process never exits nonzero.
 """
 
 from __future__ import annotations
@@ -27,12 +44,23 @@ import time
 
 TARGET_GIBPS = 20.0
 GIB = 1024 ** 3
+MIB = 1024 ** 2
 
 PROBE_TIMEOUT = 75       # backend-init watchdog, per attempt
 PROBE_ATTEMPTS = 2
-BENCH_TIMEOUT = 900      # full benchmark child watchdog
+CORE_TIMEOUT = 600
+CFG3_TIMEOUT = 480
+CFG5_TIMEOUT = 420
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
+ARTIFACTS = os.path.join(REPO, "artifacts")
+PARTIAL = os.path.join(ARTIFACTS, "BENCH_partial_r04.jsonl")
+
+#: Starting per-shard slab length for the headline stream. 16 MiB/shard
+#: = 160 MiB input per call — judge-verified to compile on the axon v5e
+#: (0.31 GiB+ single calls fail remote AOT compile).
+SLAB_S0 = 16 * MIB
+SLAB_MIN_S = 2 * MIB
 
 
 def log(*a):
@@ -45,7 +73,7 @@ def log(*a):
 
 def _scrubbed_env(n_cpu_devices: int = 0) -> dict:
     """Environment with the axon sitecustomize hook removed and JAX forced
-    to the in-process CPU backend (the recipe VERDICT.md verified)."""
+    to the in-process CPU backend."""
     sys.path.insert(0, REPO)
     from seaweedfs_tpu.util.scrub import scrubbed_env
     return scrubbed_env(REPO, n_cpu_devices)
@@ -67,24 +95,27 @@ def _run(args: list, env: dict, timeout: int):
             stdout=subprocess.PIPE, stderr=sys.stderr,
             timeout=timeout, text=True)
         return proc.returncode, proc.stdout
-    except subprocess.TimeoutExpired:
-        return -1, ""
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return -1, out or ""
     except Exception as e:  # noqa: BLE001 — parent must never die
         log(f"bench child failed to launch: {e}")
         return -2, ""
 
 
-def probe_tpu() -> str | None:
+def probe_tpu(attempts: int = PROBE_ATTEMPTS) -> str | None:
     """Return the accelerator platform name, or None if the backend is
     unusable (hang, crash, or CPU-only)."""
-    for attempt in range(PROBE_ATTEMPTS):
+    for attempt in range(attempts):
         if attempt:
             time.sleep(10)
         t0 = time.perf_counter()
         rc, out = _run(["--probe"], _ambient_env(), PROBE_TIMEOUT)
         dt = time.perf_counter() - t0
         platform = out.strip().splitlines()[-1] if out.strip() else ""
-        log(f"tpu probe attempt {attempt + 1}/{PROBE_ATTEMPTS}: rc={rc} "
+        log(f"tpu probe attempt {attempt + 1}/{attempts}: rc={rc} "
             f"platform={platform!r} ({dt:.1f}s)")
         if rc == 0 and platform and platform != "cpu":
             return platform
@@ -95,180 +126,352 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
-def parent() -> None:
-    platform = probe_tpu()
-    result = None
-    if platform is not None:
-        rc, out = _run(["--child"], _ambient_env(), BENCH_TIMEOUT)
-        result = _parse_result(out)
-        if result is None:
-            log(f"tpu benchmark child failed (rc={rc}); "
-                "falling back to CPU")
-    if result is not None:
-        result["platform"] = platform
-        result["degraded"] = False
-        emit(result)
-        return
-    rc, out = _run(["--child"], _scrubbed_env(), BENCH_TIMEOUT)
-    result = _parse_result(out)
-    if result is not None:
-        result["platform"] = "cpu"
-        result["degraded"] = True
-        emit(result)
-        return
-    emit({
-        "metric": "rs_10_4_encode_1gib_device",
-        "value": 0.0,
-        "unit": "GiB/s",
-        "vs_baseline": 0.0,
-        "platform": "none",
-        "degraded": True,
-        "error": f"benchmark child failed on every backend (last rc={rc})",
-    })
-
-
 def _parse_result(out: str):
-    for line in reversed(out.strip().splitlines()):
+    """Last JSON dict on stdout = the stage's result (stage children
+    print plain result dicts like {"headline_gibps": ...})."""
+    for line in reversed((out or "").strip().splitlines()):
         try:
             obj = json.loads(line)
         except (ValueError, TypeError):
             continue
-        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+        if isinstance(obj, dict):
             return obj
     return None
 
 
+def _read_partials() -> dict:
+    """Merge every stage line the children persisted (later lines win)."""
+    merged: dict = {}
+    try:
+        with open(PARTIAL, "r", encoding="utf-8") as f:
+            for line in f:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict):
+                    merged.update(obj)
+    except OSError:
+        pass
+    return merged
+
+
+def _run_stage(flag: str, timeout: int, platform: str | None) -> str | None:
+    """Run one sub-bench stage, preferring the accelerator; fall back to a
+    scrubbed CPU child if the accelerator stage fails. Returns the platform
+    the stage actually completed on (None = both failed)."""
+    if platform is not None:
+        rc, out = _run([flag], _ambient_env(), timeout)
+        if rc == 0 and _parse_result(out) is not None:
+            return platform
+        log(f"{flag} failed on {platform} (rc={rc}); re-probing")
+        platform = probe_tpu(attempts=1)
+        if platform is not None:
+            rc, out = _run([flag, "--shrink"], _ambient_env(), timeout)
+            if rc == 0 and _parse_result(out) is not None:
+                return platform
+            log(f"{flag} retry failed (rc={rc}); falling back to CPU")
+    rc, out = _run([flag], _scrubbed_env(), timeout)
+    if rc == 0 and _parse_result(out) is not None:
+        return "cpu"
+    log(f"{flag} failed even on CPU (rc={rc})")
+    return None
+
+
+def parent() -> None:
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    try:
+        os.remove(PARTIAL)
+    except OSError:
+        pass
+
+    platform = probe_tpu()
+    stage_platforms = {}
+    stage_platforms["core"] = _run_stage("--child-core", CORE_TIMEOUT,
+                                         platform)
+    # Stages are independent: re-probe before each so a transient hang in
+    # one window does not strand the rest on CPU.
+    if platform is not None and stage_platforms["core"] == "cpu":
+        platform = probe_tpu(attempts=1)
+    stage_platforms["config3"] = _run_stage("--child-config3", CFG3_TIMEOUT,
+                                            platform)
+    if platform is not None and stage_platforms["config3"] == "cpu":
+        platform = probe_tpu(attempts=1)
+    stage_platforms["config5"] = _run_stage("--child-config5", CFG5_TIMEOUT,
+                                            platform)
+
+    merged = _read_partials()
+    extras = {k: v for k, v in merged.items()
+              if k not in ("headline_gibps",)}
+    for stage, plat in stage_platforms.items():
+        extras[f"{stage}_platform"] = plat or "failed"
+
+    headline = merged.get("headline_gibps")
+    core_plat = stage_platforms["core"]
+    if headline is None or core_plat is None:
+        emit({
+            "metric": "rs_10_4_encode_1gib_device",
+            "value": 0.0,
+            "unit": "GiB/s",
+            "vs_baseline": 0.0,
+            "platform": "none",
+            "degraded": True,
+            "extras": extras,
+            "error": "no stage produced a headline number",
+        })
+        return
+    emit({
+        "metric": "rs_10_4_encode_1gib_device",
+        "value": round(float(headline), 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(float(headline) / TARGET_GIBPS, 3),
+        "platform": core_plat,
+        "degraded": core_plat == "cpu",
+        "extras": extras,
+    })
+
+
 # --------------------------------------------------------------------------
-# child-side: the actual measurements (runs under a watchdog)
+# child-side helpers (each stage runs under its own parent watchdog)
 # --------------------------------------------------------------------------
 
-def timeit(fn, *args, warmup=2, iters=5):
-    """Median wall time of jitted fn(*args) with block_until_ready."""
+def _persist(stage_results: dict) -> None:
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    with open(PARTIAL, "a", encoding="utf-8") as f:
+        f.write(json.dumps(stage_results) + "\n")
+
+
+def _on_accelerator() -> bool:
+    from seaweedfs_tpu.ops import rs_jax
+    return rs_jax._use_pallas()
+
+
+class _ChecksumTimer:
+    """Times a sequence of device calls honestly: each output is XOR-folded
+    into a tiny on-device accumulator, and the clock stops only when the
+    accumulator's bytes are fetched to host (np.asarray). A backend whose
+    block_until_ready returns early cannot fake this; distinct inputs per
+    call prevent any result caching."""
+
+    def __init__(self):
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.acc = None
+        self.t0 = None
+
+    def start(self):
+        self.acc = None
+        self.t0 = time.perf_counter()
+
+    def fold(self, y):
+        tip = y[..., :256]
+        flat = tip.reshape(-1, 256)
+        piece = flat[0]
+        self.acc = piece if self.acc is None else self.acc ^ piece
+
+    def stop(self) -> float:
+        import numpy as np
+        np.asarray(self.acc)  # forces the whole dependency chain
+        return time.perf_counter() - self.t0
+
+
+def _make_slabs(n_bufs: int, k: int, s: int, seed: int = 0):
+    """n distinct random host arrays of shape (1, k, s) uint8."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, (1, k, s), dtype=np.uint8)
+            for _ in range(n_bufs)]
+
+
+def _compile_or_shrink(make_fn, host_slabs, k, s, min_s=SLAB_MIN_S):
+    """Compile make_fn(s) on slab 0; on failure halve the slab length and
+    regenerate buffers. Returns (fn, device_slabs, s)."""
     import jax
     import numpy as np
-    for _ in range(warmup):
-        out = fn(*args)
-        jax.block_until_ready(out)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    while True:
+        try:
+            fn = make_fn(s)
+            dev = [jax.device_put(h) for h in host_slabs]
+            jax.block_until_ready(dev)
+            y = fn(dev[0])
+            np.asarray(y[..., :8])  # real bytes back = compile succeeded
+            return fn, dev, s, host_slabs
+        except Exception as e:  # noqa: BLE001 — shrink and retry
+            if s // 2 < min_s:
+                raise
+            s //= 2
+            log(f"compile failed ({type(e).__name__}); shrinking slab to "
+                f"{s / MIB:.0f} MiB/shard")
+            n = max(len(host_slabs), -(-GIB // (k * s)))
+            host_slabs = _make_slabs(n, k, s)
 
 
-def child() -> None:
+def child_core() -> None:
+    """Smoke + headline encode + rebuild + geometries + CPU baseline."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from seaweedfs_tpu.ops import bitslice, rs_pallas
-    from seaweedfs_tpu.ops import rs_jax
     from seaweedfs_tpu.ops.rs_jax import Encoder
 
-    extras: dict = {}
+    shrink = "--shrink" in sys.argv
+    res: dict = {}
     dev = jax.devices()[0]
-    log(f"device: {dev} platform={dev.platform}")
-    # Same dispatch policy as the codec itself: Mosaic kernels only on
-    # TPU-class backends; GPU/CPU take the XLA network.
-    on_tpu = rs_jax._use_pallas()
+    on_acc = _on_accelerator()
+    log(f"device: {dev} platform={dev.platform} accelerator={on_acc}")
 
-    # -- headline: RS(10,4) encode, 1 GiB resident on device -------------
     k, m = 10, 4
     enc = Encoder(k, m)
     coefs = enc.parity_coefs
     seg = rs_pallas.SEG_BYTES
 
-    # (B, k, S): ~1 GiB total input, S aligned to the Pallas segment.
-    batch = 8 if on_tpu else 1
-    s = (GIB // (batch * k)) // seg * seg
-    if not on_tpu:
-        # CPU smoke: shrink to keep runtime sane (keep group alignment).
-        s = max(seg, (s // 64) // seg * seg)
-    total_bytes = batch * k * s
-    log(f"encode shape: ({batch}, {k}, {s}) = "
-        f"{total_bytes / GIB:.4f} GiB input")
-
-    gf_apply = rs_pallas.apply_gf_matrix if on_tpu else \
+    gf_apply = rs_pallas.apply_gf_matrix if on_acc else \
         bitslice.apply_gf_matrix
 
-    @jax.jit
-    def encode_fn(x):
-        return gf_apply(coefs, x)
+    def make_encode(s):
+        del s
+        return jax.jit(lambda x: gf_apply(coefs, x))
 
-    key = jax.random.PRNGKey(0)
-    x = jax.random.randint(key, (batch, k, s), 0, 256, dtype=jnp.uint8)
-    x = jax.device_put(x, dev)
-    jax.block_until_ready(x)
+    # -- real-device correctness smoke (gates the headline) ---------------
+    t_smoke0 = time.perf_counter()
+    _smoke(enc, gf_apply, seg)
+    res["smoke_ok"] = True
+    log(f"device smoke (encode + 2-shard reconstruct vs oracle): OK "
+        f"({time.perf_counter() - t_smoke0:.1f}s)")
+    _persist(res)
 
-    t = timeit(encode_fn, x)
-    encode_gibps = total_bytes / GIB / t
-    log(f"encode: {t*1e3:.2f} ms -> {encode_gibps:.2f} GiB/s "
+    # -- headline: ~1 GiB streamed through (1, 10, slab) device calls -----
+    s = (SLAB_S0 // 2 if shrink else SLAB_S0) // seg * seg
+    if not on_acc:
+        s = 2 * MIB  # CPU smoke scale; headline comes from native below
+    n_bufs = max(2, min(7, -(-GIB // (k * s))))
+    host_slabs = _make_slabs(n_bufs, k, s)
+    encode_fn, dev_slabs, s, host_slabs = _compile_or_shrink(
+        make_encode, host_slabs, k, s)
+    n_bufs = len(dev_slabs)
+    per_call = k * s
+    res["slab_s_mib"] = s / MIB
+    log(f"slab: (1, {k}, {s}) = {per_call / MIB:.0f} MiB input/call, "
+        f"{n_bufs} distinct buffers")
+
+    timer = _ChecksumTimer()
+    passes = 3 if on_acc else 1
+    # warm pass (all executables + buffers touched)
+    timer.start()
+    for d in dev_slabs:
+        timer.fold(encode_fn(d))
+    timer.stop()
+    timer.start()
+    for _ in range(passes):
+        for d in dev_slabs:
+            timer.fold(encode_fn(d))
+    t = timer.stop()
+    n_calls = passes * n_bufs
+    compute_gibps = n_calls * per_call / GIB / t
+    res["device_compute_gibps"] = round(compute_gibps, 3)
+    res["device_compute_bytes"] = n_calls * per_call
+    log(f"device-resident encode: {n_calls} calls x {per_call / MIB:.0f} "
+        f"MiB in {t * 1e3:.1f} ms -> {compute_gibps:.2f} GiB/s "
         f"(target {TARGET_GIBPS})")
+    _persist(res)
 
-    # -- secondary: single-shard rebuild (config 2) -----------------------
+    # optional profiler trace of one pass (never fatal)
+    try:
+        trace_dir = os.path.join(ARTIFACTS, "jax_trace_r04")
+        with jax.profiler.trace(trace_dir):
+            timer.start()
+            for d in dev_slabs:
+                timer.fold(encode_fn(d))
+            timer.stop()
+        res["profiler_trace"] = trace_dir
+        log(f"profiler trace captured: {trace_dir}")
+    except Exception as e:  # noqa: BLE001
+        log(f"profiler trace unavailable: {e}")
+
+    # -- end-to-end host->device->host stream (the PCIe/tunnel number) ----
+    from seaweedfs_tpu.pipeline import pipe
+
+    e2e_passes = 2 if on_acc else 1
+
+    def batches():
+        for _ in range(e2e_passes):
+            for h in host_slabs:
+                yield None, h
+
+    out_bytes = [0]
+
+    def write(meta, batch, result_np):
+        out_bytes[0] += result_np.size
+
+    t0 = time.perf_counter()
+    n_batches = pipe.run_pipeline(
+        batches(), lambda b: encode_fn(jnp.asarray(b)), write)
+    t_e2e = time.perf_counter() - t0
+    e2e_bytes = n_batches * per_call
+    e2e_gibps = e2e_bytes / GIB / t_e2e
+    res["e2e_stream_gibps"] = round(e2e_gibps, 3)
+    log(f"end-to-end h2d->encode->d2h stream: {e2e_bytes / GIB:.2f} GiB in "
+        f"{t_e2e:.2f} s -> {e2e_gibps:.2f} GiB/s "
+        f"({out_bytes[0] / MIB:.0f} MiB parity returned)")
+    _persist(res)
+
+    # -- single-shard rebuild (config 2) ----------------------------------
     present = list(range(14))
-    present.remove(13)  # one lost parity
+    present.remove(13)
     rebuild_coefs = enc.decode_matrix_rows(present, [13])
+    rebuild_fn = jax.jit(lambda x: gf_apply(rebuild_coefs, x))
+    timer.start()
+    timer.fold(rebuild_fn(dev_slabs[0]))
+    timer.stop()  # warm
+    timer.start()
+    for _ in range(passes):
+        for d in dev_slabs:
+            timer.fold(rebuild_fn(d))
+    t_r = timer.stop()
+    rebuild_gibps = n_calls * per_call / GIB / t_r
+    res["rebuild_1shard_gibps"] = round(rebuild_gibps, 3)
+    log(f"single-shard rebuild: {rebuild_gibps:.2f} GiB/s (target 15)")
+    _persist(res)
 
-    @jax.jit
-    def rebuild_fn(surv):
-        return gf_apply(rebuild_coefs, surv)
-
-    t_r = timeit(rebuild_fn, x)  # x's first 10 rows stand in as survivors
-    rebuild_gibps = total_bytes / GIB / t_r
-    extras["rebuild_1shard_gibps"] = round(rebuild_gibps, 3)
-    log(f"single-shard rebuild: {t_r*1e3:.2f} ms -> "
-        f"{rebuild_gibps:.2f} GiB/s (target 15)")
-
-    # -- secondary: alternate geometries (config 4) -----------------------
+    # -- alternate geometries (config 4) ----------------------------------
     for (ak, am) in ((6, 3), (12, 4)):
         aenc = Encoder(ak, am)
         acoefs = aenc.parity_coefs
-        a_s = (total_bytes // (batch * ak)) // seg * seg
-        ax = jax.random.randint(key, (batch, ak, a_s), 0, 256,
-                                dtype=jnp.uint8)
-
-        @jax.jit
-        def alt_fn(v, _c=acoefs):
-            return gf_apply(_c, v)
-
-        t_a = timeit(alt_fn, ax, warmup=1, iters=3)
-        alt_gibps = batch * ak * a_s / GIB / t_a
-        extras[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
+        alt_fn = jax.jit(lambda v, _c=acoefs: gf_apply(_c, v))
+        a_host = _make_slabs(2, ak, s, seed=ak)
+        a_dev = [jax.device_put(h) for h in a_host]
+        timer.start()
+        timer.fold(alt_fn(a_dev[0]))
+        timer.stop()  # warm
+        timer.start()
+        for _ in range(passes):
+            for d in a_dev:
+                timer.fold(alt_fn(d))
+        t_a = timer.stop()
+        alt_gibps = passes * len(a_dev) * ak * s / GIB / t_a
+        res[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
         log(f"RS({ak},{am}) encode: {alt_gibps:.2f} GiB/s")
+    _persist(res)
 
     # -- end-to-end: synthetic .dat file -> 14 shard files (config 1) -----
     try:
-        e2e_gibps = _bench_end_to_end(on_tpu)
-        extras["encode_e2e_file_gibps"] = round(e2e_gibps, 3)
+        e2e_file = _bench_end_to_end(on_acc)
+        res["encode_e2e_file_gibps"] = round(e2e_file, 3)
+        _persist(res)
     except Exception as e:  # noqa: BLE001 — sub-benches never kill the run
-        log(f"end-to-end bench unavailable: {e}")
-
-    # -- multi-volume coalesced batch encode (config 3) -------------------
-    try:
-        c3 = _bench_many_volumes(on_tpu)
-        extras["many_volumes_gibps"] = round(c3, 3)
-    except Exception as e:  # noqa: BLE001
-        log(f"config-3 bench unavailable: {e}")
-
-    # -- repair under load (config 5) -------------------------------------
-    try:
-        c5 = _bench_repair_under_load(on_tpu)
-        extras.update(c5)
-    except Exception as e:  # noqa: BLE001
-        log(f"config-5 bench unavailable: {e}")
+        log(f"end-to-end file bench unavailable: {e}")
 
     # -- reference-class CPU baseline: native AVX2 codec ------------------
     # The reference's hot loop is klauspost's SIMD Galois assembly; our
     # native/gf256_rs.cpp implements the same nibble-LUT kernel, so its
     # measured rate is this host's AVX2-class baseline for the north
     # star's ">= 10x CPU" clause (BASELINE.md last row).
+    cpu_gibps = None
     try:
         from seaweedfs_tpu.ops import rs_native
         cx = np.random.default_rng(0).integers(
-            0, 256, (k, 16 * 1024 * 1024), dtype=np.uint8)
+            0, 256, (k, 16 * MIB), dtype=np.uint8)
         rs_native.apply_gf_matrix(coefs, cx)  # warm (builds .so, tables)
         best = 1e9
         for _ in range(3):
@@ -276,24 +479,64 @@ def child() -> None:
             rs_native.apply_gf_matrix(coefs, cx)
             best = min(best, time.perf_counter() - t0)
         cpu_gibps = cx.size / GIB / best
-        extras["cpu_avx2_baseline_gibps"] = round(cpu_gibps, 3)
-        extras["speedup_vs_cpu"] = round(encode_gibps / cpu_gibps, 2)
+        res["cpu_avx2_baseline_gibps"] = round(cpu_gibps, 3)
         log(f"native AVX2 CPU baseline: {cpu_gibps:.2f} GiB/s "
-            f"(simd level {rs_native.simd_level()}); "
-            f"device speedup {encode_gibps / cpu_gibps:.1f}x")
+            f"(simd level {rs_native.simd_level()})")
     except Exception as e:  # baseline is informative, never fatal
         log(f"native CPU baseline unavailable: {e}")
 
-    print(json.dumps({
-        "metric": "rs_10_4_encode_1gib_device",
-        "value": round(encode_gibps, 3),
-        "unit": "GiB/s",
-        "vs_baseline": round(encode_gibps / TARGET_GIBPS, 3),
-        "extras": extras,
-    }), flush=True)
+    # Headline: the device-resident number on an accelerator. When this
+    # child runs on CPU (degraded), the honest headline is the DISPATCHED
+    # CPU path — the native AVX2 codec — with the XLA-network number kept
+    # in extras (round-2 advisor finding).
+    if on_acc:
+        headline = compute_gibps
+    else:
+        res["cpu_xla_bitslice_gibps"] = round(compute_gibps, 3)
+        headline = cpu_gibps if cpu_gibps is not None else compute_gibps
+    res["headline_gibps"] = round(headline, 3)
+    if cpu_gibps:
+        res["speedup_vs_cpu"] = round(headline / cpu_gibps, 2)
+    _persist(res)
+    print(json.dumps(res), flush=True)
 
 
-def _bench_end_to_end(on_tpu: bool) -> float:
+def _smoke(enc, gf_apply, seg: int) -> None:
+    """Encode + 2-shard reconstruct of one slab on the REAL backend,
+    checked byte-for-byte against the NumPy oracle. Raises on mismatch."""
+    import jax
+    import numpy as np
+
+    from seaweedfs_tpu.ops import rs_ref
+
+    k, m = enc.data_shards, enc.parity_shards
+    rng = np.random.default_rng(42)
+    x = rng.integers(0, 256, (1, k, seg), dtype=np.uint8)
+    ref = rs_ref.ReferenceEncoder(k, m)
+    shards = [x[0, i].copy() for i in range(k)] + \
+             [np.zeros(seg, dtype=np.uint8) for _ in range(m)]
+    ref.encode(shards)
+    want_parity = np.stack(shards[k:])
+
+    fn = jax.jit(lambda v: gf_apply(enc.parity_coefs, v))
+    got = np.asarray(fn(jax.device_put(x)))[0]
+    if not np.array_equal(got, want_parity):
+        raise AssertionError("device encode mismatch vs NumPy oracle")
+
+    # lose shards 0 (data) and 11 (parity); rebuild from survivors
+    present = [i for i in range(k + m) if i not in (0, 11)]
+    rows = enc.decode_matrix_rows(present, [0, 11])
+    # decode rows are expressed over the FIRST k survivors
+    surv = np.stack([shards[i] for i in present[:k]])[None]
+    fn2 = jax.jit(lambda v: gf_apply(rows, v))
+    got2 = np.asarray(fn2(jax.device_put(surv)))[0]
+    if not np.array_equal(got2[0], shards[0]):
+        raise AssertionError("device data-shard reconstruct mismatch")
+    if not np.array_equal(got2[1], shards[11]):
+        raise AssertionError("device parity-shard reconstruct mismatch")
+
+
+def _bench_end_to_end(on_acc: bool) -> float:
     """Config 1 end-to-end: synthetic .dat on disk -> 14 shard files,
     through the pipelined encode path (disk read / H2D / compute / D2H
     overlap). Returns GiB/s of .dat bytes processed."""
@@ -305,14 +548,14 @@ def _bench_end_to_end(on_tpu: bool) -> float:
     from seaweedfs_tpu.storage import superblock as superblock_mod
     from seaweedfs_tpu.storage import volume as volume_mod
 
-    size = GIB if on_tpu else 64 * 1024 * 1024
+    size = GIB if on_acc else 64 * MIB
     with tempfile.TemporaryDirectory() as td:
         base = os.path.join(td, "1")
         rng = np.random.default_rng(7)
         with open(volume_mod.dat_path(base), "wb") as f:
             f.write(superblock_mod.SuperBlock().to_bytes())
             remaining = size - 8
-            chunk = 64 * 1024 * 1024
+            chunk = 64 * MIB
             while remaining > 0:
                 n = min(chunk, remaining)
                 f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
@@ -326,46 +569,60 @@ def _bench_end_to_end(on_tpu: bool) -> float:
         return gibps
 
 
-def _bench_many_volumes(on_tpu: bool) -> float:
+def child_config3() -> None:
     """Config 3: many small volumes coalesced into large device batches.
-    Uses in-memory volume payloads (the batcher's device path) to measure
-    aggregate encode throughput."""
+
+    Payloads are drawn from a small pool of distinct buffers instead of
+    materializing N full volumes (1000 x 30 MB would be ~30 GB of host
+    RAM — round-2 advisor finding); the batcher only reads them."""
     import numpy as np
 
     from seaweedfs_tpu.pipeline import batch as batch_mod
 
-    n_volumes = 1000 if on_tpu else 32
-    vol_bytes = 30 * 1024 * 1024 if on_tpu else 1024 * 1024
+    on_acc = _on_accelerator()
+    shrink = "--shrink" in sys.argv
+    n_volumes = 1000 if on_acc else 32
+    vol_bytes = 30 * MIB if on_acc else MIB
+    # Device batches must stay under the judge-verified per-call compile
+    # bound (~0.31 GiB total); 128 MiB input + parity is comfortably in.
+    max_batch = (64 * MIB if shrink else 128 * MIB) if on_acc \
+        else batch_mod.DEFAULT_MAX_BATCH_BYTES
+    pool_n = 8
     rng = np.random.default_rng(3)
-    payloads = [rng.integers(0, 256, vol_bytes, dtype=np.uint8)
-                for _ in range(n_volumes)]
+    pool = [rng.integers(0, 256, vol_bytes, dtype=np.uint8)
+            for _ in range(pool_n)]
+    payloads = [pool[i % pool_n] for i in range(n_volumes)]
     # warm: compile on a single small batch
-    batch_mod.encode_many(payloads[:2])
+    batch_mod.encode_many(payloads[:2], max_batch_bytes=max_batch)
     t0 = time.perf_counter()
-    batch_mod.encode_many(payloads)
+    total, _ = batch_mod.encode_many(payloads, max_batch_bytes=max_batch)
     dt = time.perf_counter() - t0
-    total = n_volumes * vol_bytes
     gibps = total / GIB / dt
     log(f"config-3 coalesced encode ({n_volumes} x "
-        f"{vol_bytes / 1024 / 1024:.0f} MB): {dt:.2f} s -> "
+        f"{vol_bytes / MIB:.0f} MB): {dt:.2f} s -> "
         f"{gibps:.2f} GiB/s aggregate")
-    return gibps
+    res = {"many_volumes_gibps": round(gibps, 3)}
+    _persist(res)
+    print(json.dumps(res), flush=True)
 
 
-def _bench_repair_under_load(on_tpu: bool) -> dict:
+def child_config5() -> None:
     """Config 5: streaming 4-shard-loss decode while 64-QPS concurrent
-    interval repairs ride the micro-batch aggregator. Returns sustained
-    decode GiB/s and read p99 latency."""
+    interval repairs ride the micro-batch aggregator."""
     from seaweedfs_tpu.pipeline import repair_bench
 
-    res = repair_bench.run(
-        duration_s=8.0 if on_tpu else 3.0,
+    on_acc = _on_accelerator()
+    shrink = "--shrink" in sys.argv
+    r = repair_bench.run(
+        duration_s=8.0 if on_acc else 3.0,
         qps=64,
-        shard_len=(32 * 1024 * 1024) if on_tpu else (2 * 1024 * 1024))
-    log(f"config-5 repair-under-load: decode {res['decode_gibps']:.2f} "
-        f"GiB/s sustained, read p99 {res['read_p99_ms']:.2f} ms")
-    return {"repair_decode_gibps": round(res["decode_gibps"], 3),
-            "repair_read_p99_ms": round(res["read_p99_ms"], 3)}
+        shard_len=((8 if shrink else 16) * MIB) if on_acc else (2 * MIB))
+    log(f"config-5 repair-under-load: decode {r['decode_gibps']:.2f} "
+        f"GiB/s sustained, read p99 {r['read_p99_ms']:.2f} ms")
+    res = {"repair_decode_gibps": round(r["decode_gibps"], 3),
+           "repair_read_p99_ms": round(r["read_p99_ms"], 3)}
+    _persist(res)
+    print(json.dumps(res), flush=True)
 
 
 def probe_child() -> None:
@@ -376,7 +633,11 @@ def probe_child() -> None:
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         probe_child()
-    elif "--child" in sys.argv:
-        child()
+    elif "--child-core" in sys.argv:
+        child_core()
+    elif "--child-config3" in sys.argv:
+        child_config3()
+    elif "--child-config5" in sys.argv:
+        child_config5()
     else:
         parent()

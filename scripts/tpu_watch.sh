@@ -1,0 +1,41 @@
+#!/bin/bash
+# Watch for the intermittent axon TPU tunnel to come back; when a probe
+# succeeds, run the full benchmark and persist the attempt as an artifact.
+# Stops once a non-degraded (real-TPU) benchmark result is recorded.
+# Skips probing while artifacts/tpu.lock exists (a foreground job owns
+# the exclusive tunnel).
+set -o pipefail
+cd /root/repo || exit 1
+mkdir -p artifacts
+LOG=artifacts/tpu_watch.log
+while true; do
+  if [ -f artifacts/TPU_SUCCESS ]; then
+    echo "$(date +%s) success-marker-present; watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  if [ -f artifacts/tpu.lock ]; then
+    echo "$(date +%s) skipped (tpu.lock held)" >> "$LOG"
+    sleep 120
+    continue
+  fi
+  PLATFORM=$(timeout 90 python bench.py --probe 2>/dev/null | tail -1)
+  RC=$?
+  echo "$(date +%s) probe rc=$RC platform=$PLATFORM" >> "$LOG"
+  if [ "$RC" = "0" ] && [ -n "$PLATFORM" ] && [ "$PLATFORM" != "cpu" ]; then
+    TS=$(date +%s)
+    echo "$TS tpu up; running full bench" >> "$LOG"
+    touch artifacts/tpu.lock
+    timeout 2400 python bench.py \
+      > "artifacts/BENCH_attempt_$TS.json" \
+      2> "artifacts/BENCH_attempt_$TS.log"
+    BRC=$?
+    rm -f artifacts/tpu.lock
+    echo "$TS bench rc=$BRC: $(cat artifacts/BENCH_attempt_$TS.json)" >> "$LOG"
+    if grep -q '"degraded": false' "artifacts/BENCH_attempt_$TS.json"; then
+      cp "artifacts/BENCH_attempt_$TS.json" artifacts/TPU_SUCCESS
+      echo "$TS non-degraded TPU result recorded; watcher exiting" >> "$LOG"
+      exit 0
+    fi
+  fi
+  sleep 180
+done

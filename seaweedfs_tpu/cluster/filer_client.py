@@ -10,7 +10,9 @@ never re-implements chunking.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -137,16 +139,31 @@ class FilerClient:
         ``file_mode`` are carried onto the new entry afterwards.
 
         Self-copy is a no-op (the first window's overwrite would reclaim
-        the source's own chunks and truncate it). ANY mid-copy failure —
-        short read, source deleted (404), source shrank (range error) —
-        removes the partial destination and raises, never leaving a
-        truncated copy that later GETs would serve as intact."""
+        the source's own chunks and truncate it). The copy lands in a
+        temp sibling entry and is swapped over ``dst_path`` only once
+        complete — ANY mid-copy failure (short read, source deleted,
+        source shrank) removes only the temp and raises, so a
+        pre-existing destination is never destroyed or left truncated
+        by a failed copy."""
         if src_path == dst_path:
             return 0
+        dst_dir, _, dst_name = dst_path.rpartition("/")
+        tmp_name = f".{dst_name}.copy-{os.getpid()}-{time.time_ns()}"
+        tmp_path = f"{dst_dir}/{tmp_name}"
+        # Sweep temps orphaned by a copier that died mid-copy (their
+        # chunks would otherwise leak forever and show up in listings).
+        # Concurrent copies to the SAME destination are undefined, so
+        # any sibling matching the prefix is a leftover, not a peer.
+        try:
+            for e in self.list(dst_dir or "/",
+                               prefix=f".{dst_name}.copy-"):
+                self.delete_data(f"{dst_dir}/{e.name}")
+        except Exception:  # noqa: BLE001 — sweep is best-effort
+            pass
         off = 0
         try:
             if size == 0:
-                self.put_data(dst_path, b"", mime=mime)
+                self.put_data(tmp_path, b"", mime=mime)
             while off < size:
                 data = self.get_data(src_path, off,
                                      min(window, size - off))
@@ -154,24 +171,46 @@ class FilerClient:
                     raise FilerClientError(
                         f"short read copying {src_path} at {off}/{size} "
                         "(source changed mid-copy)")
-                self.put_data(dst_path, data, mime=mime,
+                self.put_data(tmp_path, data, mime=mime,
                               query="op=append" if off else "")
                 off += len(data)
+            if extended or file_mode:
+                dup = self.lookup(dst_dir or "/", tmp_name)
+                if dup is not None:
+                    for k, v in (extended or {}).items():
+                        dup.extended[k] = v
+                    if file_mode:
+                        dup.attributes.file_mode = file_mode
+                    self.create(dst_dir or "/", dup)
         except Exception:
             try:
-                self.delete_data(dst_path)
-            except FilerClientError:
+                self.delete_data(tmp_path)
+            except Exception:  # noqa: BLE001 — never mask the cause
                 pass
             raise
-        if extended or file_mode:
-            d, _, n = dst_path.rpartition("/")
-            dup = self.lookup(d or "/", n)
-            if dup is not None:
-                for k, v in (extended or {}).items():
-                    dup.extended[k] = v
-                if file_mode:
-                    dup.attributes.file_mode = file_mode
-                self.create(d or "/", dup)
+        # Swap in: reclaim the old destination's chunks, then move the
+        # finished copy over the name. Past this point the copy is
+        # complete — a failure must never delete it (once the old
+        # destination is gone, the temp holds the only copy).
+        try:
+            self.delete_data(dst_path)
+        except Exception:
+            try:
+                self.delete_data(tmp_path)  # dst intact; drop the temp
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        try:
+            self.rename(dst_dir or "/", tmp_name, dst_dir or "/",
+                        dst_name)
+        except Exception as e:
+            try:
+                self.rename(dst_dir or "/", tmp_name, dst_dir or "/",
+                            dst_name)
+            except Exception:
+                raise FilerClientError(
+                    f"copied {src_path} but failed to move into place; "
+                    f"complete copy preserved at {tmp_path}") from e
         return off
 
     def delete_data(self, path: str, recursive: bool = False) -> None:

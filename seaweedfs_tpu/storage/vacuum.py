@@ -146,61 +146,120 @@ def commit_compact(vol: Volume, state: CompactState) -> int:
         raise VolumeError(
             f"volume {vol.volume_id}: no compaction in progress")
     with vol._lock:
-        vol._idx.flush()
-        vol._dat.flush()
-        idx_now = idx_path(vol.base).stat().st_size
-        idx_now -= idx_now % 16
-        with open(cpd_path(vol.base), "r+b") as nd, \
-                open(cpx_path(vol.base), "r+b") as nx:
-            nd.seek(0, 2)
-            nx.seek(0, 2)
-            # Replay the diff journal (makeupDiff): appends copy the
-            # record across, deletes tombstone the compact index.
-            if idx_now > state.idx_snapshot_bytes:
-                with open(idx_path(vol.base), "rb") as f:
-                    f.seek(state.idx_snapshot_bytes)
-                    diff = f.read(idx_now - state.idx_snapshot_bytes)
-                dat_fd = vol._dat.fileno()
-                for e in walk_index_blob(diff):
-                    if e.is_deleted:
-                        nx.write(IndexEntry(
-                            e.key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
-                        continue
-                    rec_size = needle_mod.record_size(
-                        e.size, vol.super_block.version)
-                    rec = os.pread(dat_fd, rec_size, e.byte_offset)
-                    pos = nd.tell()
-                    if pos % NEEDLE_PADDING_SIZE:
-                        pad = (-pos) % NEEDLE_PADDING_SIZE
-                        nd.write(b"\x00" * pad)
-                        pos += pad
-                    nd.write(rec)
-                    nx.write(IndexEntry(e.key, to_offset_units(pos),
-                                        e.size).to_bytes())
-            nd.flush()
-            os.fsync(nd.fileno())
-            nx.flush()
-            os.fsync(nx.fileno())
-        # Swap: close handles, rename .cpd/.cpx over .dat/.idx (dat
-        # first; load-time checking tolerates a torn pair), reopen.
-        vol._dat.close()
-        vol._idx.close()
+        # Drain in-flight readers FIRST: Condition.wait releases the
+        # volume lock, so waiting any later (after the diff replay)
+        # would let a writer append an acknowledged needle to the old
+        # .dat/.idx that the renames below silently discard. Once the
+        # drain returns, the lock is held continuously through replay
+        # and swap — no reader can touch the dying fd, no writer can
+        # land a post-replay record. _swap_pending parks NEW readers so
+        # a stream of overlapping reads cannot starve the drain.
+        vol._swap_pending = True
+        try:
+            return _commit_swap_drained(vol, state)
+        finally:
+            vol._swap_pending = False
+            vol._no_readers.notify_all()
+
+
+def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
+    """Diff replay + fd swap; runs under vol._lock with _swap_pending
+    set (new readers parked). Factored out of commit_compact so the
+    flag clears on every exit path."""
+    while vol._readers:
+        vol._no_readers.wait()
+    vol._idx.flush()
+    vol._dat.flush()
+    idx_now = idx_path(vol.base).stat().st_size
+    idx_now -= idx_now % 16
+    with open(cpd_path(vol.base), "r+b") as nd, \
+            open(cpx_path(vol.base), "r+b") as nx:
+        nd.seek(0, 2)
+        nx.seek(0, 2)
+        # Replay the diff journal (makeupDiff): appends copy the
+        # record across, deletes tombstone the compact index.
+        if idx_now > state.idx_snapshot_bytes:
+            with open(idx_path(vol.base), "rb") as f:
+                f.seek(state.idx_snapshot_bytes)
+                diff = f.read(idx_now - state.idx_snapshot_bytes)
+            dat_fd = vol._dat.fileno()
+            for e in walk_index_blob(diff):
+                if e.is_deleted:
+                    nx.write(IndexEntry(
+                        e.key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
+                    continue
+                rec_size = needle_mod.record_size(
+                    e.size, vol.super_block.version)
+                rec = os.pread(dat_fd, rec_size, e.byte_offset)
+                if len(rec) < rec_size:
+                    raise VolumeError(
+                        f"short read replaying diff for needle "
+                        f"{e.key}: {len(rec)} < {rec_size}")
+                pos = nd.tell()
+                if pos % NEEDLE_PADDING_SIZE:
+                    pad = (-pos) % NEEDLE_PADDING_SIZE
+                    nd.write(b"\x00" * pad)
+                    pos += pad
+                nd.write(rec)
+                nx.write(IndexEntry(e.key, to_offset_units(pos),
+                                    e.size).to_bytes())
+        nd.flush()
+        os.fsync(nd.fileno())
+        nx.flush()
+        os.fsync(nx.fileno())
+    # Swap: close handles, rename .cpd/.cpx over .dat/.idx (dat
+    # first; load-time checking tolerates a torn pair), reopen.
+    vol._dat.close()
+    vol._idx.close()
+    try:
         os.replace(cpd_path(vol.base), dat_path(vol.base))
-        os.replace(cpx_path(vol.base), idx_path(vol.base))
+    except OSError:
+        # Nothing swapped yet: reopen the untouched live files so the
+        # volume stays serviceable; abort_compact discards .cpd/.cpx.
         vol._dat = open(dat_path(vol.base), "r+b")
         vol._idx = open(idx_path(vol.base), "a+b")
-        vol.super_block = state.new_super
-        vol.nm = CompactMap.load_from_idx(idx_path(vol.base))
         vol._dat.seek(0, 2)
-        vol.vacuum_in_progress = False
-        return vol._dat.tell()
+        raise
+    try:
+        os.replace(cpx_path(vol.base), idx_path(vol.base))
+    except OSError:
+        # Torn commit: the compacted .dat is live and .cpx is its only
+        # index. Keep .cpx on disk (cleanup() preserves this state) and
+        # take the volume out of service — the next load() installs it.
+        vol._dat = vol._idx = None
+        raise
+    vol._dat = open(dat_path(vol.base), "r+b")
+    vol._idx = open(idx_path(vol.base), "a+b")
+    vol.super_block = state.new_super
+    vol.nm = CompactMap.load_from_idx(idx_path(vol.base))
+    vol._dat.seek(0, 2)
+    vol.vacuum_in_progress = False
+    return vol._dat.tell()
 
 
 def cleanup(base: str | Path) -> None:
-    """Remove leftover compact files (crash before commit)."""
-    for p in (cpd_path(base), cpx_path(base)):
-        if p.exists():
-            p.unlink()
+    """Remove leftover compact files (crash before commit).
+
+    Unlink order matters: load() reads a ``.cpx``-present/``.cpd``-absent
+    state as "crash between the commit renames" and installs the .cpx
+    over the live .idx. Deleting .cpd first would make an interrupted
+    cleanup fabricate exactly that state from a merely-aborted compaction
+    — installing a STALE index over a valid one. Deleting .cpx first
+    leaves at worst a .cpd-only state, which load() discards.
+
+    And a genuinely torn commit must be preserved here, not cleaned:
+    commit's first rename CONSUMES .cpd (``.cpd`` → ``.dat``), so a
+    .cpx-present/.cpd-absent state proves the compacted .dat is already
+    live and the .cpx is the only index matching it. An error-path
+    abort_compact (e.g. the master's VacuumVolumeCleanup after a failed
+    commit) deleting that .cpx would strand the new .dat with the stale
+    pre-compact .idx — unrecoverable. Leave it for load() to finish."""
+    cpx, cpd = cpx_path(base), cpd_path(base)
+    if not cpd.exists():
+        return  # nothing, or a torn commit whose .cpx load() will install
+    if cpx.exists():
+        cpx.unlink()
+    cpd.unlink()
 
 
 def abort_compact(vol: Volume) -> None:

@@ -58,8 +58,19 @@ class Volume:
         self.vacuum_in_progress = False
         # Appends mutate shared file-handle state; reads use os.pread on
         # the raw fd, so only writers serialize (volume server threads
-        # hit one Volume concurrently).
+        # hit one Volume concurrently). Readers register under the lock
+        # (consistent needle-map + fd snapshot) and pread outside it;
+        # commit_compact drains them via _no_readers before closing and
+        # swapping the fd — otherwise a read could hit a closed (or
+        # kernel-reused) descriptor, or pre-compact offsets on the
+        # compacted file.
         self._lock = threading.RLock()
+        self._readers = 0
+        #: True while commit_compact drains readers for the fd swap; new
+        #: readers block on _no_readers until it clears, so a stream of
+        #: overlapping reads cannot starve the swap.
+        self._swap_pending = False
+        self._no_readers = threading.Condition(self._lock)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -145,15 +156,27 @@ class Volume:
 
     def read_needle(self, key: int, cookie: Optional[int] = None
                     ) -> needle_mod.Needle:
-        entry = self.nm.get(key)
-        if entry is None:
-            raise KeyError(f"needle {key} not found")
-        if self._dat is None:
-            raise VolumeError("volume not open")
-        rec = os.pread(
-            self._dat.fileno(),
-            needle_mod.record_size(entry.size, self.super_block.version),
-            entry.byte_offset)
+        with self._lock:
+            while self._swap_pending:
+                self._no_readers.wait()
+            entry = self.nm.get(key)
+            if entry is None:
+                raise KeyError(f"needle {key} not found")
+            if self._dat is None:
+                raise VolumeError("volume not open")
+            fd = self._dat.fileno()
+            self._readers += 1
+        try:
+            rec = os.pread(
+                fd,
+                needle_mod.record_size(entry.size,
+                                       self.super_block.version),
+                entry.byte_offset)
+        finally:
+            with self._lock:
+                self._readers -= 1
+                if not self._readers:
+                    self._no_readers.notify_all()
         n = needle_mod.Needle.parse(rec, self.super_block.version)
         if n.id != key:
             raise VolumeError(

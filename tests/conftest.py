@@ -19,6 +19,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests that spawn python subprocesses (shell CLI, cluster choreography)
+# must not let the children dial the exclusive axon TPU tunnel — it can
+# hang at init and one claim blocks every other process. Strip the
+# sitecustomize trigger and its PYTHONPATH hook from the inherited env.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and "axon" not in p)
 
 import jax  # noqa: E402
 

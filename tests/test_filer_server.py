@@ -209,3 +209,72 @@ def test_subscribe_metadata_stream(stack):
     assert ev.event_notification.new_entry.name in ("sub", "notify.txt")
     stream.cancel()
     ch.close()
+
+
+def test_copy_data_failure_preserves_existing_destination(stack):
+    """A failed copy must not destroy a pre-existing destination
+    (round-2 advisor finding: the old failure path deleted dst)."""
+    from seaweedfs_tpu.cluster.filer_client import (FilerClient,
+                                                    FilerClientError)
+
+    _, _, filer = stack
+    fc = FilerClient(filer.url)
+    try:
+        _put(filer, "/cp/src.bin", b"s" * 100)
+        _put(filer, "/cp/dst.bin", b"d" * 64)
+        # Fail the copy after the first window landed in the temp file.
+        orig_get = fc.get_data
+
+        def flaky_get(path, offset=0, length=None):
+            if offset >= 64:
+                raise FilerClientError("injected mid-copy failure")
+            return orig_get(path, offset, length)
+
+        fc.get_data = flaky_get
+        with pytest.raises(FilerClientError, match="injected"):
+            fc.copy_data("/cp/src.bin", "/cp/dst.bin", size=100,
+                         window=64)
+        fc.get_data = orig_get
+        assert _get(filer, "/cp/dst.bin") == b"d" * 64
+        # No temp entries left behind.
+        listing = json.loads(_get(filer, "/cp"))
+        names = [e["path"].rsplit("/", 1)[-1]
+                 for e in listing.get("entries", [])]
+        assert all("copy-" not in n for n in names)
+        # A successful copy still replaces the destination.
+        n = fc.copy_data("/cp/src.bin", "/cp/dst.bin", size=100,
+                         window=64)
+        assert n == 100
+        assert _get(filer, "/cp/dst.bin") == b"s" * 100
+    finally:
+        fc.close()
+
+
+def test_copy_data_swap_failure_preserves_bytes(stack):
+    """If the final move-into-place fails after the old destination was
+    reclaimed, the finished copy must survive (at the temp path) — never
+    deleted by the failure handler."""
+    from seaweedfs_tpu.cluster.filer_client import (FilerClient,
+                                                    FilerClientError)
+
+    _, _, filer = stack
+    fc = FilerClient(filer.url)
+    try:
+        _put(filer, "/cps/src.bin", b"s" * 80)
+        _put(filer, "/cps/dst.bin", b"d" * 16)
+
+        def broken_rename(*a, **kw):
+            raise FilerClientError("injected rename failure")
+
+        fc.rename = broken_rename
+        with pytest.raises(FilerClientError, match="preserved at"):
+            fc.copy_data("/cps/src.bin", "/cps/dst.bin", size=80)
+        # The complete copy survives at the temp path named in the error.
+        listing = json.loads(_get(filer, "/cps"))
+        names = [e["path"].rsplit("/", 1)[-1]
+                 for e in listing.get("entries", [])]
+        tmp = [n for n in names if "copy-" in n]
+        assert tmp, names
+        assert _get(filer, f"/cps/{tmp[0]}") == b"s" * 80
+    finally:
+        fc.close()

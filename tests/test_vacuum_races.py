@@ -1,0 +1,203 @@
+"""Regression tests for the round-2 advisor findings: cleanup unlink
+order, commit-vs-read fd race, diff-replay short read, and the filer
+copy failure path (the last lives in tests/test_filer_server.py's
+domain but is colocated here with the other advisor regressions)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import (Volume, VolumeError, dat_path,
+                                          generate_synthetic_volume,
+                                          idx_path)
+
+
+def _fill(base, n=40, seed=0):
+    vol = generate_synthetic_volume(base, 1, n_needles=n, seed=seed)
+    payloads = {}
+    for i in range(1, n + 1):
+        payloads[i] = vol.read_needle(i).data
+    return vol, payloads
+
+
+def test_cleanup_unlinks_cpx_before_cpd(tmp_path, monkeypatch):
+    """An interrupted cleanup() must never leave the .cpx-only state
+    that load() interprets as a torn commit (which would install the
+    stale compact index over the valid live .idx)."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base)
+    for k in range(1, 21):
+        vol.delete_needle(k)
+    state = vacuum_mod.compact(vol)
+    del state
+    # Simulate dying after the FIRST unlink of cleanup().
+    first_unlink = {}
+    real_unlink = os.unlink
+
+    class Boom(RuntimeError):
+        pass
+
+    def dying_unlink(p, *a, **kw):
+        if not first_unlink:
+            first_unlink["path"] = str(p)
+            real_unlink(p, *a, **kw)
+            raise Boom("crash mid-cleanup")
+        return real_unlink(p, *a, **kw)
+
+    monkeypatch.setattr(os, "unlink", dying_unlink)
+    monkeypatch.setattr("pathlib.Path.unlink",
+                        lambda self: dying_unlink(str(self)))
+    with pytest.raises(Boom):
+        vacuum_mod.abort_compact(vol)
+    monkeypatch.undo()
+    # The surviving leftover must NOT be .cpx-only.
+    assert first_unlink["path"].endswith(".cpx")
+    cpx = vacuum_mod.cpx_path(base)
+    cpd = vacuum_mod.cpd_path(base)
+    assert not cpx.exists()
+    assert cpd.exists()
+    vol.close()
+    # Reload: the .cpd-only leftover is discarded; every pre-compact
+    # needle (including the ones only in the live .idx) must survive.
+    vol2 = Volume(base, 1).load()
+    for k in range(21, 41):
+        assert vol2.read_needle(k).data == payloads[k]
+    assert not cpd.exists()
+    vol2.close()
+
+
+def test_read_during_commit_compact_never_misreads(tmp_path):
+    """Readers racing commit_compact() must always get correct bytes —
+    never EBADF, never pre-compact offsets against the compacted file."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=60)
+    for k in range(1, 31):
+        vol.delete_needle(k)
+    live = {k: v for k, v in payloads.items() if k > 30}
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        keys = sorted(live)
+        i = 0
+        while not stop.is_set():
+            k = keys[i % len(keys)]
+            try:
+                n = vol.read_needle(k)
+                if n.data != live[k]:
+                    errors.append(f"wrong bytes for {k}")
+                    return
+            except KeyError:
+                pass  # deleted keys are fine
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+            i += 1
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(5):
+            state = vacuum_mod.compact(vol)
+            vacuum_mod.commit_compact(vol, state)
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    for k, v in live.items():
+        assert vol.read_needle(k).data == v
+    vol.close()
+
+
+def test_commit_diff_replay_rejects_short_read(tmp_path):
+    """A diff entry whose .dat record is missing bytes (torn concurrent
+    write) must abort the commit, not write a corrupt record."""
+    base = str(tmp_path / "1")
+    vol, _ = _fill(base, n=10)
+    state = vacuum_mod.compact(vol)
+    # Post-snapshot write, then tear its .dat bytes off.
+    n = Needle(cookie=7, id=999, data=b"x" * 4096)
+    vol.write_needle(n)
+    with vol._lock:
+        vol._dat.flush()
+        sz = dat_path(vol.base).stat().st_size
+        vol._dat.truncate(sz - 1024)
+        vol._dat.seek(0, 2)
+    with pytest.raises(VolumeError, match="short read"):
+        vacuum_mod.commit_compact(vol, state)
+    vol.close()
+
+
+def test_writes_racing_commit_compact_survive(tmp_path):
+    """Every write acknowledged during a compact/commit cycle must be
+    readable afterwards — the drain must not open a window where a
+    write lands in the old .dat after the diff replay."""
+    base = str(tmp_path / "1")
+    vol, _ = _fill(base, n=20)
+    for k in range(1, 11):
+        vol.delete_needle(k)
+    stop = threading.Event()
+    written = []
+    errors = []
+
+    def writer():
+        i = 10_000
+        while not stop.is_set():
+            try:
+                vol.write_needle(Needle(cookie=1, id=i,
+                                        data=b"w" * 128))
+                written.append(i)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(5):
+            state = vacuum_mod.compact(vol)
+            vacuum_mod.commit_compact(vol, state)
+            time.sleep(0.005)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    assert written
+    for i in written:
+        assert vol.read_needle(i).data == b"w" * 128, \
+            f"acknowledged write {i} lost by commit_compact"
+    vol.close()
+
+
+def test_cleanup_preserves_torn_commit_marker(tmp_path):
+    """cleanup()/abort_compact after a commit that already renamed
+    .cpd over .dat must NOT delete the .cpx — it is the only index
+    matching the now-live compacted .dat."""
+    base = str(tmp_path / "1")
+    vol, payloads = _fill(base, n=30)
+    for k in range(1, 16):
+        vol.delete_needle(k)
+    vacuum_mod.compact(vol)
+    # Simulate the commit dying between its two renames.
+    vol.close()
+    os.replace(vacuum_mod.cpd_path(base), dat_path(base))
+    vacuum_mod.cleanup(base)  # the error-path abort
+    assert vacuum_mod.cpx_path(base).exists(), \
+        "cleanup destroyed the torn-commit recovery marker"
+    vol2 = Volume(base, 1).load()
+    for k in range(16, 31):
+        assert vol2.read_needle(k).data == payloads[k]
+    for k in range(1, 16):
+        with pytest.raises(KeyError):
+            vol2.read_needle(k)
+    vol2.close()
