@@ -190,12 +190,13 @@ def parent() -> None:
     stage_platforms["core"] = _run_stage("--child-core", CORE_TIMEOUT,
                                          platform)
     # Stages are independent: re-probe before each so a transient hang in
-    # one window does not strand the rest on CPU.
-    if platform is not None and stage_platforms["core"] == "cpu":
+    # one window does not strand the rest on CPU (including when the
+    # FIRST probe was the one that hung).
+    if stage_platforms["core"] in ("cpu", None):
         platform = probe_tpu(attempts=1)
     stage_platforms["config3"] = _run_stage("--child-config3", CFG3_TIMEOUT,
                                             platform)
-    if platform is not None and stage_platforms["config3"] == "cpu":
+    if stage_platforms["config3"] in ("cpu", None):
         platform = probe_tpu(attempts=1)
     stage_platforms["config5"] = _run_stage("--child-config5", CFG5_TIMEOUT,
                                             platform)
@@ -371,6 +372,10 @@ def child_core() -> None:
     compute_gibps = n_calls * per_call / GIB / t
     res["device_compute_gibps"] = round(compute_gibps, 3)
     res["device_compute_bytes"] = n_calls * per_call
+    if on_acc:
+        # Persist the headline the moment it exists: a later sub-bench
+        # failing (or the watchdog firing) must not discard it.
+        res["headline_gibps"] = round(compute_gibps, 3)
     log(f"device-resident encode: {n_calls} calls x {per_call / MIB:.0f} "
         f"MiB in {t * 1e3:.1f} ms -> {compute_gibps:.2f} GiB/s "
         f"(target {TARGET_GIBPS})")
@@ -436,22 +441,28 @@ def child_core() -> None:
 
     # -- alternate geometries (config 4) ----------------------------------
     for (ak, am) in ((6, 3), (12, 4)):
-        aenc = Encoder(ak, am)
-        acoefs = aenc.parity_coefs
-        alt_fn = jax.jit(lambda v, _c=acoefs: gf_apply(_c, v))
-        a_host = _make_slabs(2, ak, s, seed=ak)
-        a_dev = [jax.device_put(h) for h in a_host]
-        timer.start()
-        timer.fold(alt_fn(a_dev[0]))
-        timer.stop()  # warm
-        timer.start()
-        for _ in range(passes):
-            for d in a_dev:
-                timer.fold(alt_fn(d))
-        t_a = timer.stop()
-        alt_gibps = passes * len(a_dev) * ak * s / GIB / t_a
-        res[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
-        log(f"RS({ak},{am}) encode: {alt_gibps:.2f} GiB/s")
+        try:
+            aenc = Encoder(ak, am)
+            acoefs = aenc.parity_coefs
+            alt_fn = jax.jit(lambda v, _c=acoefs: gf_apply(_c, v))
+            # Keep per-call input within the k=10 slab's verified
+            # compile envelope (k*s bytes), whatever ak is.
+            a_s = min(s, (k * s // ak) // seg * seg)
+            a_host = _make_slabs(2, ak, a_s, seed=ak)
+            a_dev = [jax.device_put(h) for h in a_host]
+            timer.start()
+            timer.fold(alt_fn(a_dev[0]))
+            timer.stop()  # warm
+            timer.start()
+            for _ in range(passes):
+                for d in a_dev:
+                    timer.fold(alt_fn(d))
+            t_a = timer.stop()
+            alt_gibps = passes * len(a_dev) * ak * a_s / GIB / t_a
+            res[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
+            log(f"RS({ak},{am}) encode: {alt_gibps:.2f} GiB/s")
+        except Exception as e:  # noqa: BLE001 — secondary metric only
+            log(f"RS({ak},{am}) bench unavailable: {e}")
     _persist(res)
 
     # -- end-to-end: synthetic .dat file -> 14 shard files (config 1) -----

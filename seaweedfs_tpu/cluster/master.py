@@ -18,7 +18,9 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.request
 from concurrent import futures
+from pathlib import Path
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -31,6 +33,8 @@ from ..util import config as config_mod
 from ..util import glog
 from ..util import security
 from ..util.stats import Metrics
+from . import ha as ha_mod
+from .ha import NotLeaderError
 from .sequence import MemorySequencer
 from .topology import Topology, TopologyError, VolumeInfo
 
@@ -48,14 +52,30 @@ class MasterServer:
                  sequencer: Optional[MemorySequencer] = None,
                  secret: str = "", seed: Optional[int] = None,
                  garbage_threshold: float = 0.3,
-                 garbage_scan_seconds: float = 60.0):
+                 garbage_scan_seconds: float = 60.0,
+                 peers: Optional[list[str]] = None,
+                 meta_dir: Optional[str] = None,
+                 election_timeout: tuple[float, float] = (0.45, 0.9)):
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds, seed=seed)
+        if sequencer is None and meta_dir:
+            Path(meta_dir).mkdir(parents=True, exist_ok=True)
+            sequencer = MemorySequencer(
+                persist_path=Path(meta_dir) / "sequence")
         self.sequencer = sequencer or MemorySequencer()
+        # Raft-lite leader election among ``peers`` (HTTP urls incl. or
+        # excl. self — self is filtered). No peers = standing leader.
+        self.ha = ha_mod.RaftNode(
+            self.url, list(peers or []),
+            state_path=(Path(meta_dir) / "master.raft.json")
+            if meta_dir else None,
+            snapshot_state=self._ha_snapshot,
+            apply_state=self._ha_apply,
+            election_timeout=election_timeout)
         self.default_replication = default_replication
         #: Vacuum trigger: deleted/content ratio above which the reap
         #: loop drives Compact+Commit on the owning server
@@ -72,6 +92,31 @@ class MasterServer:
         self._vacuum_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._grow_lock = threading.Lock()
+
+    # ------------- HA plumbing -------------
+
+    def _ha_snapshot(self) -> dict:
+        return {"max_volume_id": self.topology.max_volume_id,
+                "sequence_next": self.sequencer.peek()}
+
+    def _ha_apply(self, state: dict) -> None:
+        self.topology.observe_max_volume_id(
+            int(state.get("max_volume_id", 0)))
+        seq = int(state.get("sequence_next", 0))
+        if seq > 1:
+            self.sequencer.set_max(seq - 1)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.ha.is_leader
+
+    @property
+    def leader_url(self) -> str:
+        return self.ha.leader or (self.url if self.is_leader else "")
+
+    def _require_leader(self) -> None:
+        if not self.is_leader:
+            raise NotLeaderError(self.leader_url)
 
     # ------------- lifecycle -------------
 
@@ -99,12 +144,14 @@ class MasterServer:
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name=f"master-reaper-{self.port}")
         self._reaper.start()
+        self.ha.start()
         glog.info("master started at %s (grpc %d)", self.url,
                   _grpc_port(self.port))
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.ha.stop()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         if self._http_server:
@@ -130,7 +177,8 @@ class MasterServer:
                 glog.warning("master: data node %s missed heartbeats, "
                              "removed from topology", url)
             tick += 1
-            if self.garbage_threshold > 0 and tick % vacuum_every == 0 \
+            if self.garbage_threshold > 0 and self.is_leader \
+                    and tick % vacuum_every == 0 \
                     and (self._vacuum_thread is None
                          or not self._vacuum_thread.is_alive()):
                 # Off the reap thread: a long compaction must not stall
@@ -215,10 +263,15 @@ class MasterServer:
                     replication: Optional[str] = None,
                     ttl: str = "") -> int:
         """Allocate one new volume on replica-placement-chosen nodes."""
+        self._require_leader()
         replication = replication or self.default_replication
         with self._grow_lock:
             targets = self.topology.pick_grow_targets(replication)
             vid = self.topology.next_volume_id()
+            # Persist + replicate the consumed id BEFORE the volume goes
+            # live: a leader crash right after allocation must not let
+            # its successor reissue the same id (raft MaxVolumeId role).
+            self.ha.replicate_now()
             for node in targets:
                 self._volume_stub(node.url).AllocateVolume(
                     volume_server_pb2.AllocateVolumeRequest(
@@ -235,6 +288,7 @@ class MasterServer:
 
     def assign(self, count: int = 1, collection: str = "",
                replication: Optional[str] = None, ttl: str = "") -> dict:
+        self._require_leader()
         replication = replication or self.default_replication
         self.metrics.counter("assign_requests").inc()
         for _attempt in (0, 1):
@@ -301,7 +355,7 @@ class _MasterServicer:
                 ms.sequencer.set_max(hb.max_file_key)
             yield master_pb2.HeartbeatResponse(
                 volume_size_limit=ms.topology.volume_size_limit,
-                leader=ms.url)
+                leader=ms.leader_url or ms.url)
 
     def Assign(self, request, context):
         try:
@@ -309,7 +363,7 @@ class _MasterServicer:
                                collection=request.collection,
                                replication=request.replication or None,
                                ttl=request.ttl)
-        except (TopologyError, ValueError) as e:
+        except (TopologyError, ValueError, NotLeaderError) as e:
             return master_pb2.AssignResponse(error=str(e))
         return master_pb2.AssignResponse(
             fid=r["fid"], url=r["url"], public_url=r["publicUrl"],
@@ -317,9 +371,16 @@ class _MasterServicer:
 
     def LookupVolume(self, request, context):
         resp = master_pb2.LookupVolumeResponse()
+        # Volume servers heartbeat only the leader; a follower's cold
+        # topology must not masquerade as "volume not found".
+        not_leader = None if self.ms.is_leader else \
+            NotLeaderError(self.ms.leader_url)
         for vid_str in request.volume_ids:
             entry = resp.volume_id_locations.add()
             entry.volume_id = vid_str
+            if not_leader is not None:
+                entry.error = str(not_leader)
+                continue
             try:
                 vid = int(vid_str.split(",")[0])
             except ValueError:
@@ -334,6 +395,9 @@ class _MasterServicer:
         return resp
 
     def LookupEcVolume(self, request, context):
+        # No per-entry error field here: raising surfaces as an RpcError
+        # the client's failover loop rotates on.
+        self.ms._require_leader()
         resp = master_pb2.LookupEcVolumeResponse(
             volume_id=request.volume_id)
         for sid, nodes in sorted(
@@ -399,17 +463,52 @@ def _make_http_handler(ms: MasterServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _proxy_to_leader(self) -> bool:
+            """Forward this request to the current leader (follower
+            masters stay useful to dumb HTTP clients), preserving the
+            method and body. Returns True if proxied; False when we ARE
+            the leader or none is known."""
+            leader = ms.leader_url
+            if ms.is_leader or not leader or leader == ms.url:
+                return False
+            try:
+                data = None
+                if self.command == "POST":
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    data = self.rfile.read(n) if n else b""
+                req = urllib.request.Request(
+                    f"http://{leader}{self.path}", data=data,
+                    method=self.command)
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    body = r.read()
+                self.send_response(r.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except Exception as e:  # noqa: BLE001
+                self._json({"error": f"leader {leader} unreachable: {e}"},
+                           503)
+            return True
+
         def do_GET(self):
             u = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             try:
                 if u.path == "/dir/assign":
+                    if self._proxy_to_leader():
+                        return
                     self._json(ms.assign(
                         count=int(q.get("count", 1)),
                         collection=q.get("collection", ""),
                         replication=q.get("replication") or None,
                         ttl=q.get("ttl", "")))
                 elif u.path == "/dir/lookup":
+                    # Volume servers heartbeat only the leader, so a
+                    # follower's topology is cold — answer from the
+                    # leader's.
+                    if self._proxy_to_leader():
+                        return
                     vid = int(str(q.get("volumeId", "0")).split(",")[0])
                     locs = ms.lookup(vid, q.get("collection", ""))
                     if not locs:
@@ -419,7 +518,10 @@ def _make_http_handler(ms: MasterServer):
                         self._json({"volumeId": str(vid),
                                     "locations": locs})
                 elif u.path in ("/cluster/status", "/dir/status"):
-                    self._json({"IsLeader": True, "Leader": ms.url,
+                    self._json({"IsLeader": ms.is_leader,
+                                "Leader": ms.leader_url or ms.url,
+                                "Peers": ms.ha.peers,
+                                "Term": ms.ha.term,
                                 "Topology": ms.topology.to_map()})
                 elif u.path == "/metrics":
                     body = ms.metrics.render().encode()
@@ -430,13 +532,27 @@ def _make_http_handler(ms: MasterServer):
                     self.wfile.write(body)
                 else:
                     self._json({"error": "not found"}, 404)
+            except NotLeaderError as e:
+                self._json({"error": str(e), "leader": e.leader}, 503)
             except (TopologyError, ValueError) as e:
                 self._json({"error": str(e)}, 500)
 
         def do_POST(self):
             u = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
-            if u.path == "/vol/grow":
+            if u.path in ("/raft/vote", "/raft/heartbeat"):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if u.path == "/raft/vote":
+                        self._json(ms.ha.handle_vote(req))
+                    else:
+                        self._json(ms.ha.handle_heartbeat(req))
+                except (ValueError, OSError) as e:
+                    self._json({"error": str(e)}, 400)
+            elif u.path == "/vol/grow":
+                if self._proxy_to_leader():
+                    return
                 try:
                     n = int(q.get("count", 1))
                     vids = [ms.grow_volume(
@@ -444,6 +560,8 @@ def _make_http_handler(ms: MasterServer):
                         q.get("replication") or None,
                         q.get("ttl", "")) for _ in range(n)]
                     self._json({"count": len(vids), "volumeIds": vids})
+                except NotLeaderError as e:
+                    self._json({"error": str(e), "leader": e.leader}, 503)
                 except (TopologyError, ValueError) as e:
                     self._json({"error": str(e)}, 500)
             else:
@@ -462,6 +580,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-peers", default="",
+                   help="comma-separated master urls for HA election")
+    p.add_argument("-mdir", default="",
+                   help="meta dir persisting raft state + sequence")
     p.add_argument("-config", default="")
     args = p.parse_args(argv)
     conf = config_mod.load(args.config) if args.config else {}
@@ -469,7 +591,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,
                       default_replication=args.defaultReplication,
-                      pulse_seconds=args.pulseSeconds, secret=secret)
+                      pulse_seconds=args.pulseSeconds, secret=secret,
+                      peers=[x for x in args.peers.split(",") if x],
+                      meta_dir=args.mdir or None)
     ms.start()
     try:
         while True:

@@ -225,6 +225,13 @@ class Topology:
             self.max_volume_id += 1
             return self.max_volume_id
 
+    def observe_max_volume_id(self, vid: int) -> None:
+        """Bump past an id seen elsewhere (HA state replication): a
+        follower promoted to leader must never reissue a volume id its
+        predecessor already consumed."""
+        with self._lock:
+            self.max_volume_id = max(self.max_volume_id, vid)
+
     # ---------------- write placement ----------------
 
     def pick_for_write(self, collection: str = "", replication: str = "000",

@@ -93,7 +93,11 @@ class VolumeServer:
         self.port = port
         self.url = f"{ip}:{port}"
         self.public_url = public_url or self.url
-        self.master_url = master_url
+        # One or more master urls (comma-separated). The heartbeat
+        # stream follows the leader the masters report; on stream
+        # failure the loop rotates through the list (HA failover).
+        self.master_urls = [u for u in master_url.split(",") if u]
+        self.master_url = self.master_urls[0] if self.master_urls else ""
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
@@ -178,6 +182,36 @@ class VolumeServer:
     def master_stub(self) -> pb.Stub:
         return pb.master_stub(self._channel(self.master_url))
 
+    def _rotate_master(self) -> None:
+        if len(self.master_urls) > 1:
+            i = self.master_urls.index(self.master_url) \
+                if self.master_url in self.master_urls else 0
+            self.master_url = self.master_urls[
+                (i + 1) % len(self.master_urls)]
+
+    def _master_call(self, fn, retryable=None):
+        """Run ``fn(master_stub)`` with HA failover: a dead master (or a
+        follower answering a leader-only rpc, detected by ``retryable``
+        on the response) rotates to the next configured master. Without
+        this, every data-plane request that consults the master would
+        500 during the window between a leader death and the heartbeat
+        loop's own rotation."""
+        import grpc
+
+        last: Exception = RuntimeError("no master configured")
+        for _ in range(max(2, len(self.master_urls) + 1)):
+            try:
+                r = fn(self.master_stub())
+                if retryable is not None and retryable(r):
+                    last = RuntimeError("master is not the leader")
+                    self._rotate_master()
+                    continue
+                return r
+            except grpc.RpcError as e:
+                last = e
+                self._rotate_master()
+        raise last
+
     def _heartbeat_snapshot(self) -> master_pb2.Heartbeat:
         st = self.store.status()
         hb = master_pb2.Heartbeat(
@@ -214,6 +248,13 @@ class VolumeServer:
                 if not self._stop.is_set():
                     glog.v(1, "heartbeat stream to %s broke: %s",
                            self.master_url, e)
+                    # HA failover: rotate to the next configured master
+                    # so a dead leader doesn't strand the heartbeat.
+                    if len(self.master_urls) > 1:
+                        i = self.master_urls.index(self.master_url) \
+                            if self.master_url in self.master_urls else 0
+                        self.master_url = self.master_urls[
+                            (i + 1) % len(self.master_urls)]
             self._stop.wait(self.pulse_seconds)
 
     def _run_heartbeat_stream(self) -> None:
@@ -227,6 +268,17 @@ class VolumeServer:
         for resp in stub.SendHeartbeat(gen()):
             if resp.volume_size_limit:
                 self.volume_size_limit = resp.volume_size_limit
+            if resp.leader and resp.leader != self.master_url:
+                # Follow the leader (the reference volume server redials
+                # whatever master the heartbeat response names). Track
+                # it in the rotation list too, so if THIS leader later
+                # dies we can still rotate back to a seed master.
+                glog.v(1, "volume %s: following leader %s", self.url,
+                       resp.leader)
+                if resp.leader not in self.master_urls:
+                    self.master_urls.append(resp.leader)
+                self.master_url = resp.leader
+                return
             if self._stop.is_set():
                 return
 
@@ -248,8 +300,8 @@ class VolumeServer:
         with self._lock:
             cached = self._ec_loc_cache.get(volume_id)
         if cached is None or now - cached[0] > 1.0:
-            resp = self.master_stub().LookupEcVolume(
-                master_pb2.LookupEcVolumeRequest(volume_id=volume_id))
+            resp = self._master_call(lambda stub: stub.LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=volume_id)))
             table = {e.shard_id: [l.url for l in e.locations]
                      for e in resp.shard_id_locations}
             with self._lock:
@@ -300,9 +352,13 @@ class VolumeServer:
                       ) -> list[str]:
         if not self.master_url:
             return []
-        resp = self.master_stub().LookupVolume(
-            master_pb2.LookupVolumeRequest(volume_ids=[str(volume_id)],
-                                           collection=collection))
+        resp = self._master_call(
+            lambda stub: stub.LookupVolume(
+                master_pb2.LookupVolumeRequest(
+                    volume_ids=[str(volume_id)], collection=collection)),
+            retryable=lambda r: any(
+                e.error and "not the leader" in e.error
+                for e in r.volume_id_locations))
         for entry in resp.volume_id_locations:
             return [l.url for l in entry.locations if l.url != self.url]
         return []

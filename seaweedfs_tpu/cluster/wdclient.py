@@ -7,6 +7,7 @@ the master's LookupVolume, so repeated reads don't hit the master.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Optional
@@ -15,10 +16,17 @@ from .. import pb
 from ..pb import master_pb2
 from .master import _grpc_port
 
+_LEADER_RE = re.compile(r"leader is ([0-9A-Za-z_.-]+:\d+)")
+
 
 class MasterClient:
+    """Accepts one or more master urls (comma-separated); follows the
+    leader named in not-leader errors and rotates on dial failure, the
+    way wdclient.MasterClient tracks the raft leader."""
+
     def __init__(self, master_url: str, cache_seconds: float = 10.0):
-        self.master_url = master_url
+        self.master_urls = [u for u in master_url.split(",") if u]
+        self.master_url = self.master_urls[0] if self.master_urls else ""
         self.cache_seconds = cache_seconds
         self._lock = threading.Lock()
         self._vid_map: dict[int, tuple[float, list[dict]]] = {}
@@ -34,6 +42,53 @@ class MasterClient:
                     f"{ip}:{_grpc_port(int(http_port))}")
             return pb.master_stub(self._channel)
 
+    def _redial(self, url: str) -> None:
+        with self._lock:
+            if url == self.master_url:
+                return
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+            self.master_url = url
+            if url not in self.master_urls:
+                self.master_urls.append(url)
+
+    def _rotate(self) -> None:
+        if len(self.master_urls) < 2:
+            return
+        i = self.master_urls.index(self.master_url) \
+            if self.master_url in self.master_urls else 0
+        self._redial(self.master_urls[(i + 1) % len(self.master_urls)])
+
+    def _with_failover(self, call):
+        """Run ``call()``; on a not-leader error follow the named
+        leader (or rotate and wait briefly when the leader is unknown
+        mid-election), on a dead connection rotate masters; retries are
+        bounded by the master count."""
+        import grpc
+
+        last: Exception = RuntimeError("no master configured")
+        for _ in range(max(3, len(self.master_urls) + 1)):
+            try:
+                return call()
+            except grpc.RpcError as e:
+                last = e
+                self._rotate()
+            except RuntimeError as e:
+                msg = str(e)
+                if "not the leader" not in msg:
+                    raise
+                last = e
+                m = _LEADER_RE.search(msg)
+                if m:
+                    self._redial(m.group(1))
+                else:
+                    # election in flight: try the next master after a
+                    # beat (elections settle in well under a second)
+                    self._rotate()
+                    time.sleep(0.3)
+        raise last
+
     def close(self) -> None:
         with self._lock:
             if self._channel is not None:
@@ -47,9 +102,17 @@ class MasterClient:
             hit = self._vid_map.get(volume_id)
             if hit and now - hit[0] < self.cache_seconds:
                 return hit[1]
-        resp = self._stub().LookupVolume(
-            master_pb2.LookupVolumeRequest(volume_ids=[str(volume_id)],
-                                           collection=collection))
+        def call():
+            resp = self._stub().LookupVolume(
+                master_pb2.LookupVolumeRequest(
+                    volume_ids=[str(volume_id)], collection=collection))
+            for entry in resp.volume_id_locations:
+                if entry.error and "not the leader" in entry.error:
+                    # retryable via the failover loop (follows leader)
+                    raise RuntimeError(entry.error)
+            return resp
+
+        resp = self._with_failover(call)
         locs: list[dict] = []
         for entry in resp.volume_id_locations:
             if entry.error:
@@ -61,18 +124,22 @@ class MasterClient:
         return locs
 
     def lookup_ec(self, volume_id: int) -> dict[int, list[str]]:
-        resp = self._stub().LookupEcVolume(
-            master_pb2.LookupEcVolumeRequest(volume_id=volume_id))
+        resp = self._with_failover(lambda: self._stub().LookupEcVolume(
+            master_pb2.LookupEcVolumeRequest(volume_id=volume_id)))
         return {e.shard_id: [l.url for l in e.locations]
                 for e in resp.shard_id_locations}
 
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "") -> dict:
-        resp = self._stub().Assign(master_pb2.AssignRequest(
-            count=count, collection=collection, replication=replication,
-            ttl=ttl))
-        if resp.error:
-            raise RuntimeError(resp.error)
+        def call():
+            resp = self._stub().Assign(master_pb2.AssignRequest(
+                count=count, collection=collection,
+                replication=replication, ttl=ttl))
+            if resp.error:
+                raise RuntimeError(resp.error)
+            return resp
+
+        resp = self._with_failover(call)
         return {"fid": resp.fid, "url": resp.url,
                 "publicUrl": resp.public_url, "count": resp.count,
                 "auth": resp.auth}
