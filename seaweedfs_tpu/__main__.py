@@ -70,6 +70,11 @@ def _run_s3(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_mount(argv: list[str]) -> int:
+    from .mount.cli import main
+    return main(argv)
+
+
 def _run_webdav(argv: list[str]) -> int:
     from .gateway.webdav import main
     return main(argv)
@@ -86,6 +91,7 @@ COMMANDS = {
     "benchmark": _run_benchmark,
     "s3": _run_s3,
     "webdav": _run_webdav,
+    "mount": _run_mount,
     "scaffold": _run_scaffold,
 }
 

@@ -88,6 +88,7 @@ class RaftNode:
         self._last_heard = time.monotonic()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._last_persisted: Optional[dict] = None
         self._load()
 
     # ------------- persistence -------------
@@ -111,16 +112,21 @@ class RaftNode:
         # Serialized on the node lock: replicate_now() runs off the
         # master's request threads while vote/heartbeat handlers persist
         # under the lock — two writers on one .tmp would tear the state
-        # file and a torn file degrades to term 0 on restart.
+        # file and a torn file degrades to term 0 on restart. Skipped
+        # when nothing changed (steady-state heartbeats would otherwise
+        # fsync ~7x/s forever on every follower).
         with self._lock:
-            tmp = self.state_path.with_suffix(".tmp")
             payload = {"term": self.term, "voted_for": self.voted_for,
                        "state": self.snapshot_state()}
+            if payload == self._last_persisted:
+                return
+            tmp = self.state_path.with_suffix(".tmp")
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(payload, f)
                 f.flush()
                 os.fsync(f.fileno())
             tmp.replace(self.state_path)
+            self._last_persisted = payload
 
     # ------------- lifecycle -------------
 
@@ -225,6 +231,11 @@ class RaftNode:
                 glog.info("raft %s: stepping down (term %d)",
                           self.self_url, term)
             self.role = FOLLOWER
+            # A deposed leader must stop advertising itself: clients
+            # redirected to a stale self-reference would spin. Unknown
+            # until the new leader's first heartbeat names it.
+            if self.leader == self.self_url:
+                self.leader = ""
             self._last_heard = time.monotonic()
 
     # ------------- leader side -------------
@@ -232,8 +243,21 @@ class RaftNode:
     def _broadcast_heartbeat(self) -> None:
         req = {"term": self.term, "leader": self.self_url,
                "state": self.snapshot_state()}
+        # Parallel: a black-holed peer must not delay the heartbeat to
+        # live followers past their election timeout (serial posts with
+        # an rpc_timeout stall would trigger spurious elections).
+        results: list[Optional[dict]] = []
+        threads = []
         for p in self.peers:
-            r = self._post(p, "/raft/heartbeat", req)
+            t = threading.Thread(
+                target=lambda p=p: results.append(
+                    self._post(p, "/raft/heartbeat", req)), daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + self.rpc_timeout
+        for t in threads:
+            t.join(timeout=max(0, deadline - time.monotonic()))
+        for r in results:
             if r and r.get("term", 0) > self.term:
                 self._step_down(r["term"])
                 return
@@ -258,6 +282,8 @@ class RaftNode:
                 self.voted_for = None
                 if self.role != FOLLOWER:
                     self.role = FOLLOWER
+                if self.leader == self.self_url:
+                    self.leader = ""
             granted = (
                 term == self.term
                 and self.voted_for in (None, req.get("candidate"))

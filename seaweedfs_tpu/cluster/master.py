@@ -506,9 +506,11 @@ def _make_http_handler(ms: MasterServer):
                 elif u.path == "/dir/lookup":
                     # Volume servers heartbeat only the leader, so a
                     # follower's topology is cold — answer from the
-                    # leader's.
+                    # leader's; mid-election (no leader known) a 503
+                    # retry signal, never a false 404.
                     if self._proxy_to_leader():
                         return
+                    ms._require_leader()
                     vid = int(str(q.get("volumeId", "0")).split(",")[0])
                     locs = ms.lookup(vid, q.get("collection", ""))
                     if not locs:

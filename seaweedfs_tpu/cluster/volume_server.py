@@ -250,11 +250,7 @@ class VolumeServer:
                            self.master_url, e)
                     # HA failover: rotate to the next configured master
                     # so a dead leader doesn't strand the heartbeat.
-                    if len(self.master_urls) > 1:
-                        i = self.master_urls.index(self.master_url) \
-                            if self.master_url in self.master_urls else 0
-                        self.master_url = self.master_urls[
-                            (i + 1) % len(self.master_urls)]
+                    self._rotate_master()
             self._stop.wait(self.pulse_seconds)
 
     def _run_heartbeat_stream(self) -> None:

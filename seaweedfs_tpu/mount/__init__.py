@@ -1,0 +1,14 @@
+"""Mount layer: WFS/Dir/File/FileHandle over a live filer.
+
+The weed/mount analog (SURVEY.md §2 "FUSE mount") built against a VFS
+seam — the environment has no FUSE library, so the kernel binding is
+the one absent piece; every filesystem operation, the dirty-page cache,
+and the chunked flush are here and tested in-process.
+"""
+
+from .file_handle import ChunkCache, FileHandle
+from .pages import DirtyPages
+from .wfs import Dir, File, FuseError, WFS
+
+__all__ = ["ChunkCache", "Dir", "DirtyPages", "File", "FileHandle",
+           "FuseError", "WFS"]

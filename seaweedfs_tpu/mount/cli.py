@@ -1,0 +1,46 @@
+"""``weed mount`` command (weed/command/mount.go analog).
+
+Mounts the filer namespace at a local directory through the ctypes
+libfuse binding. Requires /dev/fuse (container/VM with FUSE enabled);
+without it the command explains itself instead of crashing, and the
+mount layer remains fully usable in-process through mount.WFS.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from ..util import glog
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="mount")
+    p.add_argument("-filer", required=True, help="filer host:port")
+    p.add_argument("-mserver", required=True,
+                   help="master host:port (comma-separated for HA)")
+    p.add_argument("-dir", required=True, help="local mount point")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-debug", action="store_true")
+    args = p.parse_args(argv)
+
+    from . import fuse_ll
+    from .wfs import WFS
+
+    if not fuse_ll.fuse_available():
+        print("mount: libfuse/« /dev/fuse » unavailable in this "
+              "environment; use seaweedfs_tpu.mount.WFS in-process "
+              "instead", file=sys.stderr)
+        return 2
+
+    wfs = WFS(args.filer, args.mserver, collection=args.collection,
+              replication=args.replication)
+    glog.info("mounting filer %s at %s", args.filer, args.dir)
+    try:
+        return fuse_ll.mount_and_serve(wfs, args.dir,
+                                       debug=args.debug)
+    finally:
+        wfs.close()

@@ -1,0 +1,159 @@
+"""Open-file state for the mount layer: chunk reads + chunked flush.
+
+Mirrors weed/mount's FileHandle (SURVEY.md §2 "FUSE mount"): an open
+file carries a snapshot of the entry's chunk list, a dirty-page cache
+for writes, and a small LRU of fetched chunks for reads. ``flush``
+uploads every dirty interval as fresh chunks (assign fid -> POST to the
+volume server -> append FileChunk) and saves the entry through the
+filer — the chunk-overlay read path (filer/filechunks.py
+visible_intervals, later-mtime wins) makes partial overwrites correct
+without read-modify-write.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..cluster import operation
+from ..filer.entry import FileChunk
+from .pages import DirtyPages
+
+#: Flush a handle automatically once this much dirty data accumulates
+#: (weed mount's writeback threshold role).
+MAX_DIRTY_BYTES = 16 * 1024 * 1024
+#: Cap one uploaded chunk (large sequential writes split into several).
+CHUNK_SIZE = 4 * 1024 * 1024
+
+
+class ChunkCache:
+    """Tiny process-wide LRU of fetched chunk payloads."""
+
+    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024):
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._held = 0
+        self._map: OrderedDict[str, bytes] = OrderedDict()
+
+    def get(self, fid: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._map.get(fid)
+            if data is not None:
+                self._map.move_to_end(fid)
+            return data
+
+    def put(self, fid: str, data: bytes) -> None:
+        with self._lock:
+            if fid in self._map:
+                return
+            self._map[fid] = data
+            self._held += len(data)
+            while self._held > self.capacity and self._map:
+                _, old = self._map.popitem(last=False)
+                self._held -= len(old)
+
+    def invalidate(self, fid: str) -> None:
+        with self._lock:
+            old = self._map.pop(fid, None)
+            if old is not None:
+                self._held -= len(old)
+
+
+class FileHandle:
+    """One open() of a file. Not itself thread-safe for interleaved
+    writes from many threads to the SAME handle beyond the internal
+    lock; the kernel serializes per-handle ops in real FUSE."""
+
+    def __init__(self, wfs, path: str, entry, flags: int = 0):
+        self.wfs = wfs
+        self.path = path
+        self.entry = entry  # filer_pb2.Entry snapshot (mutated locally)
+        self.flags = flags
+        self.pages = DirtyPages()
+        self._lock = threading.RLock()
+        self._size = max(
+            entry.attributes.file_size,
+            max((c.offset + c.size for c in entry.chunks), default=0))
+
+    # ------------- geometry -------------
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return max(self._size, self.pages.max_stop)
+
+    # ------------- read -------------
+
+    def read(self, offset: int, length: int) -> bytes:
+        with self._lock:
+            end = min(offset + length, self.size)
+            if end <= offset:
+                return b""
+            buf = bytearray(end - offset)
+            chunks = [FileChunk(file_id=c.file_id, offset=c.offset,
+                                size=c.size, mtime_ns=c.mtime_ns)
+                      for c in self.entry.chunks]
+            from ..filer.filechunks import read_plan
+            for piece in read_plan(chunks, offset, len(buf)):
+                blob = self.wfs._fetch_chunk(piece.file_id)
+                seg = blob[piece.chunk_offset:
+                           piece.chunk_offset + piece.length]
+                buf[piece.buffer_offset:
+                    piece.buffer_offset + len(seg)] = seg
+            self.pages.overlay(offset, buf)
+            return bytes(buf)
+
+    # ------------- write -------------
+
+    def write(self, offset: int, data: bytes) -> int:
+        with self._lock:
+            self.pages.write(offset, data)
+            self._size = max(self._size, offset + len(data))
+            if self.pages.dirty_bytes >= MAX_DIRTY_BYTES:
+                self.flush()
+            return len(data)
+
+    def truncate(self, size: int) -> None:
+        with self._lock:
+            self.pages.truncate(size)
+            if size < self._size or size < self.size:
+                # Shrink: drop shadowed chunk ranges entirely when the
+                # chunk lies wholly past the cut; clip the logical size.
+                kept = [c for c in self.entry.chunks if c.offset < size]
+                del self.entry.chunks[:]
+                for c in kept:
+                    nc = self.entry.chunks.add()
+                    nc.CopyFrom(c)
+                    if nc.offset + nc.size > size:
+                        nc.size = size - nc.offset
+            self._size = size
+            self.entry.attributes.file_size = size
+            self.wfs._save_entry(self.path, self.entry)
+
+    # ------------- flush (the chunked upload) -------------
+
+    def flush(self) -> None:
+        with self._lock:
+            intervals = self.pages.pop_all()
+            if not intervals and \
+                    self.entry.attributes.file_size == self.size:
+                return
+            now_ns = time.time_ns()
+            for iv in intervals:
+                pos = 0
+                while pos < len(iv.data):
+                    piece = bytes(iv.data[pos:pos + CHUNK_SIZE])
+                    fid, url, auth = self.wfs._assign()
+                    operation.upload(url, fid, piece, jwt=auth)
+                    self.entry.chunks.add(
+                        file_id=fid, offset=iv.start + pos,
+                        size=len(piece), mtime_ns=now_ns)
+                    pos += len(piece)
+            self.entry.attributes.file_size = self.size
+            self.entry.attributes.mtime = int(time.time())
+            self.wfs._save_entry(self.path, self.entry)
+
+    def release(self) -> None:
+        self.flush()

@@ -1,0 +1,355 @@
+"""Minimal ctypes binding to libfuse 2.9 (high-level API), x86-64 Linux.
+
+The environment ships ``libfuse.so.2`` + ``fusermount`` but no Python
+FUSE package, so this module IS the kernel binding for ``weed mount``
+(weed/mount's fuse layer role, SURVEY.md §2): it marshals the VFS-seam
+operations of mount/wfs.py into a ``struct fuse_operations`` and runs
+``fuse_main_real``. Only the operation subset the WFS implements is
+wired; everything else stays NULL and libfuse answers ENOSYS.
+
+ABI notes (glibc x86-64): ``struct stat`` uses the 144-byte layout with
+``st_nlink`` before ``st_mode``; ``struct fuse_file_info`` is 40 bytes
+with the open flags first and the 64-bit handle at offset 24. Layouts
+are fixed by the platform ABI, independently of any binding library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+from typing import Optional
+
+c_off_t = ctypes.c_int64
+c_mode_t = ctypes.c_uint32
+c_dev_t = ctypes.c_uint64
+c_size_t = ctypes.c_size_t
+
+
+class Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+class Stat(ctypes.Structure):
+    _fields_ = [
+        ("st_dev", c_dev_t),
+        ("st_ino", ctypes.c_uint64),
+        ("st_nlink", ctypes.c_uint64),
+        ("st_mode", c_mode_t),
+        ("st_uid", ctypes.c_uint32),
+        ("st_gid", ctypes.c_uint32),
+        ("__pad0", ctypes.c_int),
+        ("st_rdev", c_dev_t),
+        ("st_size", c_off_t),
+        ("st_blksize", ctypes.c_long),
+        ("st_blocks", ctypes.c_int64),
+        ("st_atim", Timespec),
+        ("st_mtim", Timespec),
+        ("st_ctim", Timespec),
+        ("__glibc_reserved", ctypes.c_long * 3),
+    ]
+
+
+class FuseFileInfo(ctypes.Structure):
+    _fields_ = [
+        ("flags", ctypes.c_int),
+        ("fh_old", ctypes.c_ulong),
+        ("writepage", ctypes.c_int),
+        ("flags_bits", ctypes.c_uint),
+        ("fh", ctypes.c_uint64),
+        ("lock_owner", ctypes.c_uint64),
+    ]
+
+
+_FILLER = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
+    ctypes.POINTER(Stat), c_off_t)
+
+_GETATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.POINTER(Stat))
+_READLINK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                             ctypes.c_char_p, c_size_t)
+_MKNOD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t,
+                          c_dev_t)
+_MKDIR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t)
+_PATH1 = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p)
+_PATH2 = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)
+_CHMOD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t)
+_CHOWN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                          ctypes.c_uint32, ctypes.c_uint32)
+_TRUNCATE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_off_t)
+_UTIME = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p)
+_OPEN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                         ctypes.POINTER(FuseFileInfo))
+# NB: the data buffers are c_void_p, NOT c_char_p — ctypes converts a
+# c_char_p argument to an immutable NUL-terminated bytes COPY, which
+# both truncates binary writes at the first zero byte and makes the
+# read callback scribble into a throwaway copy instead of the kernel's
+# buffer.
+_READ = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
+                         c_size_t, c_off_t,
+                         ctypes.POINTER(FuseFileInfo))
+_WRITE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                          ctypes.c_void_p, c_size_t, c_off_t,
+                          ctypes.POINTER(FuseFileInfo))
+_STATFS = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                           ctypes.c_void_p)
+_FI_OP = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                          ctypes.POINTER(FuseFileInfo))
+_FSYNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                          ctypes.POINTER(FuseFileInfo))
+_SETXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                             ctypes.c_char_p, ctypes.c_char_p, c_size_t,
+                             ctypes.c_int)
+_GETXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                             ctypes.c_char_p, ctypes.c_char_p, c_size_t)
+_LISTXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_char_p, c_size_t)
+_READDIR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.c_void_p, _FILLER, c_off_t,
+                            ctypes.POINTER(FuseFileInfo))
+_INIT = ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p)
+_DESTROY = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_ACCESS = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int)
+_CREATE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t,
+                           ctypes.POINTER(FuseFileInfo))
+_FTRUNCATE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_off_t,
+                              ctypes.POINTER(FuseFileInfo))
+_FGETATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                             ctypes.POINTER(Stat),
+                             ctypes.POINTER(FuseFileInfo))
+_LOCK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                         ctypes.POINTER(FuseFileInfo), ctypes.c_int,
+                         ctypes.c_void_p)
+_UTIMENS = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.POINTER(Timespec * 2))
+_BMAP = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_size_t,
+                         ctypes.POINTER(ctypes.c_uint64))
+
+
+class FuseOperations(ctypes.Structure):
+    """struct fuse_operations, libfuse 2.9 layout."""
+    _fields_ = [
+        ("getattr", _GETATTR),
+        ("readlink", _READLINK),
+        ("getdir", ctypes.c_void_p),
+        ("mknod", _MKNOD),
+        ("mkdir", _MKDIR),
+        ("unlink", _PATH1),
+        ("rmdir", _PATH1),
+        ("symlink", _PATH2),
+        ("rename", _PATH2),
+        ("link", _PATH2),
+        ("chmod", _CHMOD),
+        ("chown", _CHOWN),
+        ("truncate", _TRUNCATE),
+        ("utime", _UTIME),
+        ("open", _OPEN),
+        ("read", _READ),
+        ("write", _WRITE),
+        ("statfs", _STATFS),
+        ("flush", _FI_OP),
+        ("release", _FI_OP),
+        ("fsync", _FSYNC),
+        ("setxattr", _SETXATTR),
+        ("getxattr", _GETXATTR),
+        ("listxattr", _LISTXATTR),
+        ("removexattr", _PATH2),
+        ("opendir", _OPEN),
+        ("readdir", _READDIR),
+        ("releasedir", _FI_OP),
+        ("fsyncdir", _FSYNC),
+        ("init", _INIT),
+        ("destroy", _DESTROY),
+        ("access", _ACCESS),
+        ("create", _CREATE),
+        ("ftruncate", _FTRUNCATE),
+        ("fgetattr", _FGETATTR),
+        ("lock", _LOCK),
+        ("utimens", _UTIMENS),
+        ("bmap", _BMAP),
+        ("flags_bits", ctypes.c_uint),
+        ("ioctl", ctypes.c_void_p),
+        ("poll", ctypes.c_void_p),
+        ("write_buf", ctypes.c_void_p),
+        ("read_buf", ctypes.c_void_p),
+        ("flock", ctypes.c_void_p),
+        ("fallocate", ctypes.c_void_p),
+    ]
+
+
+def _load_libfuse():
+    name = ctypes.util.find_library("fuse") or "libfuse.so.2"
+    return ctypes.CDLL(name, use_errno=True)
+
+
+def fuse_available() -> bool:
+    try:
+        _load_libfuse()
+    except OSError:
+        return False
+    return os.path.exists("/dev/fuse")
+
+
+def mount_and_serve(wfs, mountpoint: str, foreground: bool = True,
+                    debug: bool = False,
+                    fsname: str = "seaweedfs_tpu") -> int:
+    """Run the FUSE event loop on ``mountpoint`` (blocks until
+    unmounted). Single-threaded loop (-s): WFS serializes internally and
+    Python callbacks need no reentrancy."""
+    lib = _load_libfuse()
+    ops = _build_ops(wfs)
+    args = [b"seaweedfs-mount", mountpoint.encode()]
+    args += [b"-f"] if foreground else []
+    args += [b"-s", b"-o", b"fsname=%s,subtype=weed" % fsname.encode()]
+    if debug:
+        args.append(b"-d")
+    argv = (ctypes.c_char_p * len(args))(*args)
+    lib.fuse_main_real.restype = ctypes.c_int
+    return lib.fuse_main_real(len(args), argv, ctypes.byref(ops),
+                              ctypes.sizeof(ops), None)
+
+
+def _build_ops(wfs) -> FuseOperations:
+    from .wfs import FuseError
+
+    def guard(fn):
+        def wrapped(*a):
+            try:
+                r = fn(*a)
+                return 0 if r is None else r
+            except FuseError as e:
+                return -e.errno
+            except OSError as e:
+                return -(e.errno or errno.EIO)
+            except Exception:  # noqa: BLE001 — callback must not raise
+                return -errno.EIO
+        return wrapped
+
+    @guard
+    def op_getattr(path, st):
+        d = wfs.getattr(path.decode())
+        ctypes.memset(st, 0, ctypes.sizeof(Stat))
+        st.contents.st_mode = d["st_mode"]
+        st.contents.st_size = d["st_size"]
+        st.contents.st_nlink = d["st_nlink"]
+        st.contents.st_uid = d["st_uid"]
+        st.contents.st_gid = d["st_gid"]
+        st.contents.st_mtim.tv_sec = int(d["st_mtime"])
+        st.contents.st_ctim.tv_sec = int(d["st_ctime"])
+        st.contents.st_blksize = 4096
+        st.contents.st_blocks = (d["st_size"] + 511) // 512
+        return 0
+
+    @guard
+    def op_readdir(path, buf, filler, off, fi):
+        filler(buf, b".", None, 0)
+        filler(buf, b"..", None, 0)
+        for name in wfs.readdir(path.decode()):
+            filler(buf, name.encode(), None, 0)
+        return 0
+
+    @guard
+    def op_mkdir(path, mode):
+        wfs.mkdir(path.decode(), mode)
+
+    @guard
+    def op_rmdir(path):
+        wfs.rmdir(path.decode())
+
+    @guard
+    def op_unlink(path):
+        wfs.unlink(path.decode())
+
+    @guard
+    def op_rename(old, new):
+        wfs.rename(old.decode(), new.decode())
+
+    @guard
+    def op_chmod(path, mode):
+        wfs.chmod(path.decode(), mode)
+
+    @guard
+    def op_chown(path, uid, gid):
+        return 0  # single-user store; accepted and ignored
+
+    @guard
+    def op_truncate(path, size):
+        wfs.truncate(path.decode(), size)
+
+    @guard
+    def op_ftruncate(path, size, fi):
+        wfs.truncate_fh(fi.contents.fh, size)
+
+    @guard
+    def op_open(path, fi):
+        fi.contents.fh = wfs.open(path.decode(), fi.contents.flags)
+        return 0
+
+    @guard
+    def op_create(path, mode, fi):
+        fi.contents.fh = wfs.create(path.decode(), mode,
+                                    fi.contents.flags)
+        return 0
+
+    @guard
+    def op_read(path, buf, size, off, fi):
+        data = wfs.read(fi.contents.fh, off, size)
+        ctypes.memmove(buf, data, len(data))
+        return len(data)
+
+    @guard
+    def op_write(path, buf, size, off, fi):
+        return wfs.write(fi.contents.fh, off,
+                         ctypes.string_at(buf, size))
+
+    @guard
+    def op_flush(path, fi):
+        wfs.flush(fi.contents.fh)
+
+    @guard
+    def op_release(path, fi):
+        wfs.release(fi.contents.fh)
+
+    @guard
+    def op_fsync(path, datasync, fi):
+        wfs.flush(fi.contents.fh)
+
+    @guard
+    def op_utimens(path, times):
+        return 0  # timestamps tracked on flush; accepted and ignored
+
+    @guard
+    def op_access(path, mask):
+        if wfs._lookup(path.decode()) is None and path != b"/":
+            return -errno.ENOENT
+        return 0
+
+    ops = FuseOperations()
+    ops.getattr = _GETATTR(op_getattr)
+    ops.readdir = _READDIR(op_readdir)
+    ops.mkdir = _MKDIR(op_mkdir)
+    ops.rmdir = _PATH1(op_rmdir)
+    ops.unlink = _PATH1(op_unlink)
+    ops.rename = _PATH2(op_rename)
+    ops.chmod = _CHMOD(op_chmod)
+    ops.chown = _CHOWN(op_chown)
+    ops.truncate = _TRUNCATE(op_truncate)
+    ops.ftruncate = _FTRUNCATE(op_ftruncate)
+    ops.open = _OPEN(op_open)
+    ops.create = _CREATE(op_create)
+    ops.read = _READ(op_read)
+    ops.write = _WRITE(op_write)
+    ops.flush = _FI_OP(op_flush)
+    ops.release = _FI_OP(op_release)
+    ops.fsync = _FSYNC(op_fsync)
+    ops.utimens = _UTIMENS(op_utimens)
+    ops.access = _ACCESS(op_access)
+    # keep the callback closures alive for the lifetime of the mount
+    ops._keepalive = [op_getattr, op_readdir, op_mkdir, op_rmdir,
+                      op_unlink, op_rename, op_chmod, op_chown,
+                      op_truncate, op_ftruncate, op_open, op_create,
+                      op_read, op_write, op_flush, op_release,
+                      op_fsync, op_utimens, op_access]
+    return ops

@@ -1,0 +1,103 @@
+"""Dirty-page cache for the mount layer's write path.
+
+Mirrors weed/mount's ContinuousDirtyPages (SURVEY.md §2 "FUSE mount"):
+writes land in RAM as byte intervals; overlapping/adjacent intervals
+merge so a sequential writer accumulates ONE interval; flush uploads
+each interval as a file chunk (the chunked-flush half lives in
+file_handle.py). Reads through an open handle overlay the dirty
+intervals on whatever the stored chunks say, so read-your-writes holds
+before any flush.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Optional
+
+
+class DirtyInterval:
+    __slots__ = ("start", "data")
+
+    def __init__(self, start: int, data: bytearray):
+        self.start = start
+        self.data = data
+
+    @property
+    def stop(self) -> int:
+        return self.start + len(self.data)
+
+
+class DirtyPages:
+    """Sorted, disjoint, merged dirty byte intervals for one file."""
+
+    def __init__(self):
+        self._iv: list[DirtyInterval] = []
+
+    # ------------- write -------------
+
+    def write(self, offset: int, data: bytes) -> None:
+        if not data:
+            return
+        new = DirtyInterval(offset, bytearray(data))
+        starts = [iv.start for iv in self._iv]
+        i = bisect.bisect_left(starts, new.start)
+        # absorb any interval that touches/overlaps [start, stop]
+        lo = i
+        while lo > 0 and self._iv[lo - 1].stop >= new.start:
+            lo -= 1
+        hi = i
+        while hi < len(self._iv) and self._iv[hi].start <= new.stop:
+            hi += 1
+        if lo == hi:
+            self._iv.insert(i, new)
+            return
+        merged_start = min(new.start, self._iv[lo].start)
+        merged_stop = max(new.stop, self._iv[hi - 1].stop)
+        buf = bytearray(merged_stop - merged_start)
+        for iv in self._iv[lo:hi]:
+            buf[iv.start - merged_start:iv.stop - merged_start] = iv.data
+        buf[new.start - merged_start:new.stop - merged_start] = new.data
+        self._iv[lo:hi] = [DirtyInterval(merged_start, buf)]
+
+    # ------------- read overlay -------------
+
+    def overlay(self, offset: int, buf: bytearray) -> None:
+        """Patch ``buf`` (representing file bytes [offset, offset+len))
+        with any dirty bytes in that range."""
+        stop = offset + len(buf)
+        for iv in self._iv:
+            if iv.stop <= offset or iv.start >= stop:
+                continue
+            lo = max(offset, iv.start)
+            hi = min(stop, iv.stop)
+            buf[lo - offset:hi - offset] = \
+                iv.data[lo - iv.start:hi - iv.start]
+
+    # ------------- flush / truncate -------------
+
+    def pop_all(self) -> list[DirtyInterval]:
+        out, self._iv = self._iv, []
+        return out
+
+    def truncate(self, size: int) -> None:
+        """Drop dirty bytes at or past ``size``."""
+        keep: list[DirtyInterval] = []
+        for iv in self._iv:
+            if iv.start >= size:
+                continue
+            if iv.stop > size:
+                iv.data = iv.data[:size - iv.start]
+            if iv.data:
+                keep.append(iv)
+        self._iv = keep
+
+    @property
+    def dirty_bytes(self) -> int:
+        return sum(len(iv.data) for iv in self._iv)
+
+    @property
+    def max_stop(self) -> int:
+        return max((iv.stop for iv in self._iv), default=0)
+
+    def __bool__(self) -> bool:
+        return bool(self._iv)

@@ -1,0 +1,285 @@
+"""WFS: the mount layer's filesystem object (weed/mount analog).
+
+Mirrors weed/mount's WFS/Dir/File/FileHandle split (SURVEY.md §2 "FUSE
+mount", ~4k LoC in the reference): WFS owns the filer/master clients,
+the chunk cache, and the open-handle table; Dir and File are thin node
+views used by a FUSE binding. The environment ships no FUSE library, so
+the kernel-facing half is a clean VFS seam — every method here has the
+fuse-op shape (lookup/getattr/mkdir/create/open/read/write/flush/
+release/unlink/rmdir/rename/truncate) and a binding only needs to
+marshal errno codes. The substance — the dirty-page cache and chunked
+flush — lives in pages.py / file_handle.py and is fully exercised
+in-process against a live filer (tests/test_mount.py).
+
+Data path: reads resolve the entry's chunk list through the same
+interval overlay the filer uses and fetch whole chunks from volume
+servers via the master's lookup (LRU-cached); writes buffer in dirty
+pages and flush as fresh chunks (assign -> upload -> entry update), so
+partial overwrites never read-modify-write.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import stat as stat_mod
+import threading
+import time
+from typing import Iterator, Optional
+
+from ..cluster import operation
+from ..cluster.filer_client import FilerClient
+from ..cluster.wdclient import MasterClient
+from ..pb import filer_pb2
+from .file_handle import ChunkCache, FileHandle
+
+
+class FuseError(OSError):
+    """VFS-level error carrying an errno (the binding's marshaling
+    surface)."""
+
+    def __init__(self, err: int, msg: str = ""):
+        super().__init__(err, msg or os.strerror(err))
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = "/" + path.strip("/")
+    d, _, n = path.rpartition("/")
+    return d or "/", n
+
+
+class WFS:
+    def __init__(self, filer_url: str, master_url: str,
+                 chunk_cache_bytes: int = 64 * 1024 * 1024,
+                 collection: str = "", replication: str = ""):
+        self.filer = FilerClient(filer_url)
+        self.master = MasterClient(master_url)
+        self.collection = collection
+        self.replication = replication
+        self.chunk_cache = ChunkCache(chunk_cache_bytes)
+        self._lock = threading.Lock()
+        self._next_fh = 1
+        self._handles: dict[int, FileHandle] = {}
+
+    def close(self) -> None:
+        for fh in list(self._handles.values()):
+            try:
+                fh.release()
+            except Exception:  # noqa: BLE001 — close() must not raise
+                pass
+        self._handles.clear()
+        self.filer.close()
+        self.master.close()
+
+    # ------------- plumbing used by FileHandle -------------
+
+    def _assign(self) -> tuple[str, str, str]:
+        a = operation.assign(self.master, collection=self.collection,
+                             replication=self.replication)
+        return a.fid, a.url, a.auth
+
+    def _fetch_chunk(self, fid: str) -> bytes:
+        data = self.chunk_cache.get(fid)
+        if data is None:
+            data = operation.download(self.master, fid,
+                                      collection=self.collection)
+            self.chunk_cache.put(fid, data)
+        return data
+
+    def _save_entry(self, path: str, entry) -> None:
+        d, _ = _split(path)
+        self.filer.create(d, entry)
+
+    def _lookup(self, path: str):
+        d, n = _split(path)
+        if not n:  # root
+            e = filer_pb2.Entry(name="/", is_directory=True)
+            e.attributes.file_mode = 0o755
+            return e
+        return self.filer.lookup(d, n)
+
+    # ------------- fuse-op surface -------------
+
+    def getattr(self, path: str) -> dict:
+        e = self._lookup(path)
+        if e is None:
+            raise FuseError(errno.ENOENT, path)
+        size = max(e.attributes.file_size,
+                   max((c.offset + c.size for c in e.chunks), default=0))
+        # an open handle may hold a newer (unflushed) size
+        with self._lock:
+            for h in self._handles.values():
+                if h.path == "/" + path.strip("/"):
+                    size = max(size, h.size)
+        mode = e.attributes.file_mode or (0o755 if e.is_directory
+                                          else 0o644)
+        mode |= stat_mod.S_IFDIR if e.is_directory else stat_mod.S_IFREG
+        return {"st_mode": mode, "st_size": size,
+                "st_mtime": e.attributes.mtime or 0,
+                "st_ctime": e.attributes.crtime or 0,
+                "st_uid": e.attributes.uid, "st_gid": e.attributes.gid,
+                "st_nlink": 2 if e.is_directory else 1}
+
+    def readdir(self, path: str) -> Iterator[str]:
+        d = "/" + path.strip("/")
+        if self._lookup(path) is None and d != "/":
+            raise FuseError(errno.ENOENT, path)
+        for e in self.filer.list(d):
+            yield e.name
+
+    def mkdir(self, path: str, mode: int = 0o755) -> None:
+        d, n = _split(path)
+        if not n:
+            raise FuseError(errno.EEXIST, path)
+        e = filer_pb2.Entry(name=n, is_directory=True)
+        e.attributes.file_mode = mode
+        e.attributes.crtime = int(time.time())
+        self.filer.create(d, e)
+
+    def rmdir(self, path: str) -> None:
+        e = self._lookup(path)
+        if e is None:
+            raise FuseError(errno.ENOENT, path)
+        if not e.is_directory:
+            raise FuseError(errno.ENOTDIR, path)
+        if next(iter(self.readdir(path)), None) is not None:
+            raise FuseError(errno.ENOTEMPTY, path)
+        d, n = _split(path)
+        self.filer.delete(d, n, recursive=False, delete_data=False)
+
+    def create(self, path: str, mode: int = 0o644, flags: int = 0) -> int:
+        d, n = _split(path)
+        e = filer_pb2.Entry(name=n, is_directory=False)
+        e.attributes.file_mode = mode
+        e.attributes.crtime = int(time.time())
+        e.attributes.mtime = e.attributes.crtime
+        self.filer.create(d, e)
+        return self.open(path, flags | os.O_CREAT)
+
+    def open(self, path: str, flags: int = 0) -> int:
+        e = self._lookup(path)
+        if e is None:
+            if not flags & os.O_CREAT:
+                raise FuseError(errno.ENOENT, path)
+            return self.create(path)
+        if e.is_directory:
+            raise FuseError(errno.EISDIR, path)
+        if flags & os.O_TRUNC:
+            del e.chunks[:]
+            e.attributes.file_size = 0
+            self._save_entry(path, e)
+        h = FileHandle(self, "/" + path.strip("/"), e, flags)
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = h
+        return fh
+
+    def _handle(self, fh: int) -> FileHandle:
+        h = self._handles.get(fh)
+        if h is None:
+            raise FuseError(errno.EBADF, str(fh))
+        return h
+
+    def read(self, fh: int, offset: int, length: int) -> bytes:
+        return self._handle(fh).read(offset, length)
+
+    def write(self, fh: int, offset: int, data: bytes) -> int:
+        return self._handle(fh).write(offset, data)
+
+    def flush(self, fh: int) -> None:
+        self._handle(fh).flush()
+
+    def truncate_fh(self, fh: int, size: int) -> None:
+        self._handle(fh).truncate(size)
+
+    def truncate(self, path: str, size: int) -> None:
+        fh = self.open(path)
+        try:
+            self.truncate_fh(fh, size)
+        finally:
+            self.release(fh)
+
+    def release(self, fh: int) -> None:
+        with self._lock:
+            h = self._handles.pop(fh, None)
+        if h is not None:
+            h.release()
+
+    def unlink(self, path: str) -> None:
+        e = self._lookup(path)
+        if e is None:
+            raise FuseError(errno.ENOENT, path)
+        if e.is_directory:
+            raise FuseError(errno.EISDIR, path)
+        d, n = _split(path)
+        self.filer.delete(d, n, delete_data=True)
+        for c in e.chunks:
+            self.chunk_cache.invalidate(c.file_id)
+
+    def rename(self, old: str, new: str) -> None:
+        if self._lookup(old) is None:
+            raise FuseError(errno.ENOENT, old)
+        od, on = _split(old)
+        nd, nn = _split(new)
+        self.filer.rename(od, on, nd, nn)
+
+    def chmod(self, path: str, mode: int) -> None:
+        e = self._lookup(path)
+        if e is None:
+            raise FuseError(errno.ENOENT, path)
+        e.attributes.file_mode = mode & 0o7777
+        self._save_entry(path, e)
+
+    # ------------- node views (the reference's Dir/File objects) -----
+
+    def root(self) -> "Dir":
+        return Dir(self, "/")
+
+
+class Dir:
+    """Directory node view (weed/mount Dir analog)."""
+
+    def __init__(self, wfs: WFS, path: str):
+        self.wfs = wfs
+        self.path = "/" + path.strip("/")
+
+    def _child(self, name: str) -> str:
+        return (self.path.rstrip("/") + "/" + name) if name else self.path
+
+    def lookup(self, name: str):
+        p = self._child(name)
+        e = self.wfs._lookup(p)
+        if e is None:
+            raise FuseError(errno.ENOENT, p)
+        return Dir(self.wfs, p) if e.is_directory else File(self.wfs, p)
+
+    def readdir(self) -> Iterator[str]:
+        return self.wfs.readdir(self.path)
+
+    def mkdir(self, name: str, mode: int = 0o755) -> "Dir":
+        self.wfs.mkdir(self._child(name), mode)
+        return Dir(self.wfs, self._child(name))
+
+    def create(self, name: str, mode: int = 0o644) -> int:
+        return self.wfs.create(self._child(name), mode)
+
+    def unlink(self, name: str) -> None:
+        self.wfs.unlink(self._child(name))
+
+    def rmdir(self, name: str) -> None:
+        self.wfs.rmdir(self._child(name))
+
+
+class File:
+    """File node view (weed/mount File analog)."""
+
+    def __init__(self, wfs: WFS, path: str):
+        self.wfs = wfs
+        self.path = "/" + path.strip("/")
+
+    def open(self, flags: int = 0) -> int:
+        return self.wfs.open(self.path, flags)
+
+    def getattr(self) -> dict:
+        return self.wfs.getattr(self.path)

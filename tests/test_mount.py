@@ -1,0 +1,263 @@
+"""Mount layer: dirty pages, WFS ops over a live filer, chunked flush,
+and (when the environment allows) a real kernel FUSE mount."""
+
+import errno
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.mount import DirtyPages, FuseError, WFS
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+# ---------------- dirty pages (pure) ----------------
+
+def test_dirty_pages_merge_and_overlay():
+    dp = DirtyPages()
+    dp.write(0, b"aaaa")
+    dp.write(10, b"bbbb")
+    assert len(dp._iv) == 2
+    dp.write(4, b"cccccc")  # bridges [0,4) and [10,14)
+    assert len(dp._iv) == 1
+    assert dp._iv[0].start == 0 and dp._iv[0].stop == 14
+    assert bytes(dp._iv[0].data) == b"aaaaccccccbbbb"
+    buf = bytearray(b"x" * 20)
+    dp.overlay(0, buf)
+    assert bytes(buf[:14]) == b"aaaaccccccbbbb"
+    assert bytes(buf[14:]) == b"x" * 6
+    dp.truncate(6)
+    assert dp.max_stop == 6
+    assert bytes(dp._iv[0].data) == b"aaaacc"
+
+
+def test_dirty_pages_overwrite_within():
+    dp = DirtyPages()
+    dp.write(0, b"0123456789")
+    dp.write(3, b"XYZ")
+    assert len(dp._iv) == 1
+    assert bytes(dp._iv[0].data) == b"012XYZ6789"
+
+
+# ---------------- WFS over a live cluster ----------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=3,
+                          garbage_threshold=0).start()
+    d = tmp_path_factory.mktemp("mntvol")
+    store = Store([d], max_volumes=8)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url,
+                      pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def wfs(cluster):
+    master, _, filer = cluster
+    w = WFS(filer.url, master.url)
+    yield w
+    w.close()
+
+
+def test_create_write_read_roundtrip(wfs):
+    fh = wfs.create("/docs/hello.txt")
+    assert wfs.write(fh, 0, b"hello ") == 6
+    assert wfs.write(fh, 6, b"world") == 5
+    # read-your-writes before flush
+    assert wfs.read(fh, 0, 100) == b"hello world"
+    wfs.release(fh)
+    # fresh handle reads flushed chunks
+    fh2 = wfs.open("/docs/hello.txt")
+    assert wfs.read(fh2, 0, 100) == b"hello world"
+    assert wfs.read(fh2, 6, 5) == b"world"
+    wfs.release(fh2)
+    st = wfs.getattr("/docs/hello.txt")
+    assert st["st_size"] == 11
+
+
+def test_partial_overwrite_via_chunk_overlay(wfs):
+    fh = wfs.create("/docs/patch.bin")
+    wfs.write(fh, 0, b"A" * 100)
+    wfs.release(fh)
+    fh = wfs.open("/docs/patch.bin")
+    wfs.write(fh, 40, b"B" * 10)  # overlay, no read-modify-write
+    wfs.release(fh)
+    fh = wfs.open("/docs/patch.bin")
+    data = wfs.read(fh, 0, 200)
+    wfs.release(fh)
+    assert data == b"A" * 40 + b"B" * 10 + b"A" * 50
+    # the entry now has 2+ chunks, resolved by mtime overlay
+    e = wfs._lookup("/docs/patch.bin")
+    assert len(e.chunks) >= 2
+
+
+def test_large_write_chunks_and_flush_threshold(wfs):
+    from seaweedfs_tpu.mount import file_handle as fh_mod
+    payload = os.urandom(int(fh_mod.CHUNK_SIZE * 2.5))
+    fh = wfs.create("/docs/big.bin")
+    wfs.write(fh, 0, payload)
+    wfs.release(fh)
+    e = wfs._lookup("/docs/big.bin")
+    assert len(e.chunks) == 3  # split at CHUNK_SIZE
+    fh = wfs.open("/docs/big.bin")
+    assert wfs.read(fh, 0, len(payload) + 7) == payload
+    # ranged read crossing a chunk boundary
+    lo = fh_mod.CHUNK_SIZE - 1000
+    assert wfs.read(fh, lo, 4000) == payload[lo:lo + 4000]
+    wfs.release(fh)
+
+
+def test_mkdir_readdir_rename_unlink(wfs):
+    wfs.mkdir("/work")
+    fh = wfs.create("/work/a.txt")
+    wfs.write(fh, 0, b"a")
+    wfs.release(fh)
+    assert "a.txt" in list(wfs.readdir("/work"))
+    wfs.rename("/work/a.txt", "/work/b.txt")
+    names = list(wfs.readdir("/work"))
+    assert "b.txt" in names and "a.txt" not in names
+    fh = wfs.open("/work/b.txt")
+    assert wfs.read(fh, 0, 10) == b"a"
+    wfs.release(fh)
+    wfs.unlink("/work/b.txt")
+    with pytest.raises(FuseError) as ei:
+        wfs.open("/work/b.txt")
+    assert ei.value.errno == errno.ENOENT
+    wfs.rmdir("/work")
+    with pytest.raises(FuseError):
+        wfs.rmdir("/work")
+
+
+def test_rmdir_nonempty_refused(wfs):
+    wfs.mkdir("/full")
+    fh = wfs.create("/full/x")
+    wfs.release(fh)
+    with pytest.raises(FuseError) as ei:
+        wfs.rmdir("/full")
+    assert ei.value.errno == errno.ENOTEMPTY
+    wfs.unlink("/full/x")
+    wfs.rmdir("/full")
+
+
+def test_truncate_shrink_and_grow(wfs):
+    fh = wfs.create("/docs/trunc.bin")
+    wfs.write(fh, 0, b"0123456789")
+    wfs.release(fh)
+    wfs.truncate("/docs/trunc.bin", 4)
+    fh = wfs.open("/docs/trunc.bin")
+    assert wfs.read(fh, 0, 100) == b"0123"
+    wfs.release(fh)
+    assert wfs.getattr("/docs/trunc.bin")["st_size"] == 4
+
+
+def test_o_trunc_open(wfs):
+    fh = wfs.create("/docs/ot.bin")
+    wfs.write(fh, 0, b"longcontent")
+    wfs.release(fh)
+    fh = wfs.open("/docs/ot.bin", os.O_TRUNC)
+    wfs.write(fh, 0, b"new")
+    wfs.release(fh)
+    fh = wfs.open("/docs/ot.bin")
+    assert wfs.read(fh, 0, 100) == b"new"
+    wfs.release(fh)
+
+
+def test_node_views(wfs):
+    root = wfs.root()
+    d = root.mkdir("nodes")
+    fh = d.create("f.txt")
+    wfs.write(fh, 0, b"n")
+    wfs.release(fh)
+    f = d.lookup("f.txt")
+    assert f.getattr()["st_size"] == 1
+    d.unlink("f.txt")
+    root.rmdir("nodes")
+
+
+# ---------------- real kernel mount (skips without FUSE) -------------
+
+def _can_fuse():
+    from seaweedfs_tpu.mount import fuse_ll
+    if not fuse_ll.fuse_available():
+        return False
+    return os.access("/dev/fuse", os.R_OK | os.W_OK)
+
+
+@pytest.mark.skipif(not _can_fuse(), reason="no usable /dev/fuse")
+def test_real_kernel_mount(cluster, tmp_path):
+    master, _, filer = cluster
+    mnt = tmp_path / "mnt"
+    mnt.mkdir()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "mount",
+         "-filer", filer.url, "-mserver", master.url,
+         "-dir", str(mnt)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 15
+        mounted = False
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.skip("fuse mount exited: "
+                            f"{proc.stderr.read().decode()[-300:]}")
+            if os.path.ismount(mnt):
+                mounted = True
+                break
+            time.sleep(0.1)
+        if not mounted:
+            pytest.skip("mount did not appear (environment restriction)")
+        p = mnt / "kernel.txt"
+        p.write_bytes(b"through the kernel")
+        assert p.read_bytes() == b"through the kernel"
+        sub = mnt / "sub"
+        sub.mkdir()
+        assert "sub" in os.listdir(mnt)
+        (sub / "x.bin").write_bytes(os.urandom(3 * 1024 * 1024))
+        assert (sub / "x.bin").stat().st_size == 3 * 1024 * 1024
+        os.rename(sub / "x.bin", sub / "y.bin")
+        assert os.listdir(sub) == ["y.bin"]
+        os.unlink(sub / "y.bin")
+        os.rmdir(sub)
+    finally:
+        subprocess.run(["fusermount", "-u", str(mnt)],
+                       capture_output=True)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
