@@ -75,6 +75,11 @@ def _run_mount(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_filer_replicate(argv: list[str]) -> int:
+    from .replication.replicator import main
+    return main(argv)
+
+
 def _run_webdav(argv: list[str]) -> int:
     from .gateway.webdav import main
     return main(argv)
@@ -92,6 +97,7 @@ COMMANDS = {
     "s3": _run_s3,
     "webdav": _run_webdav,
     "mount": _run_mount,
+    "filer.replicate": _run_filer_replicate,
     "scaffold": _run_scaffold,
 }
 

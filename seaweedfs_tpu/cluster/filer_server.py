@@ -201,7 +201,8 @@ class _FilerServicer:
         # subscribe wait-loop forever and block process exit.
         context.add_callback(stop.set)
         prefix = request.path_prefix or "/"
-        for ev in self.fs.filer.subscribe(stop):
+        for ev in self.fs.filer.subscribe(stop,
+                                          since_ns=request.since_ns):
             if not context.is_active():
                 stop.set()
                 return

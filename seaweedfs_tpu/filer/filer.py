@@ -16,6 +16,7 @@ Filer itself stays a pure metadata object (testable without servers).
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from dataclasses import dataclass, field
@@ -50,9 +51,16 @@ class Filer:
     #: maxMB upload split.
     CHUNK_SIZE = 4 * 1024 * 1024
 
+    #: Bounded replayable meta-log window (filer_notify.go's persisted
+    #: log role): subscribers can catch up from ``since_ns`` as long as
+    #: it is still inside the window.
+    META_LOG_EVENTS = 10_000
+
     def __init__(self, store: Optional[FilerStore] = None):
         self.store = store or MemoryStore()
         self._subs: list[_Subscriber] = []
+        self._meta_log: collections.deque[MetaEvent] = collections.deque(
+            maxlen=self.META_LOG_EVENTS)
         self._lock = threading.RLock()
         # Serializes read-modify-write namespace ops (o_excl check +
         # insert, parent checks, recursive delete) across the threaded
@@ -171,20 +179,47 @@ class Filer:
         ev = MetaEvent(ts_ns=time.time_ns(), directory=directory,
                        old_entry=old, new_entry=new)
         with self._lock:
+            self._meta_log.append(ev)
             subs = list(self._subs)
         for s in subs:
             with s.cond:
                 s.queue.append(ev)
                 s.cond.notify()
 
-    def subscribe(self, stop: Optional[threading.Event] = None
-                  ) -> Iterator[MetaEvent]:
+    def meta_log_covers(self, since_ns: int) -> bool:
+        """Whether replay from ``since_ns`` is gap-free: the log either
+        never wrapped, or its oldest retained event predates the resume
+        point. A wrapped log with a newer head means events in
+        (since_ns, head] were evicted — the subscriber must re-sync,
+        not silently resume (the reference errors here too)."""
+        with self._lock:
+            if len(self._meta_log) < self.META_LOG_EVENTS:
+                return True
+            return self._meta_log[0].ts_ns <= since_ns
+
+    def subscribe(self, stop: Optional[threading.Event] = None,
+                  since_ns: int = 0) -> Iterator[MetaEvent]:
         """Blocking event stream (SubscribeMetadata). Iterate on a
-        dedicated thread; set ``stop`` to end the stream."""
+        dedicated thread; set ``stop`` to end the stream.
+
+        ``since_ns > 0`` first replays logged events newer than that
+        timestamp (up to the META_LOG_EVENTS window), then streams live.
+        Registration and the replay snapshot happen under one lock, so
+        no event is lost or duplicated across the seam."""
         sub = _Subscriber()
         with self._lock:
+            if since_ns and not self.meta_log_covers(since_ns):
+                raise FilerError(
+                    f"meta log window expired for since_ns={since_ns}; "
+                    "full re-sync required")
+            replay = [ev for ev in self._meta_log
+                      if ev.ts_ns > since_ns] if since_ns else []
             self._subs.append(sub)
         try:
+            for ev in replay:
+                if stop is not None and stop.is_set():
+                    return
+                yield ev
             while stop is None or not stop.is_set():
                 with sub.cond:
                     while not sub.queue:

@@ -1,0 +1,13 @@
+"""Cross-cluster filer replication (weed/replication analog).
+
+A Replicator subscribes to a source filer's metadata stream
+(``SubscribeMetadata``, with since-ns replay through the filer's
+meta-log window) and applies each mutation to a sink. The first sink is
+another filer (``FilerSink``) — the reference's filer sink — copying
+file CONTENT, so the destination owns fresh chunks in its own cluster.
+"""
+
+from .replicator import Replicator
+from .sinks import FilerSink, ReplicationSink
+
+__all__ = ["FilerSink", "ReplicationSink", "Replicator"]

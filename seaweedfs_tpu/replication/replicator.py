@@ -1,0 +1,188 @@
+"""The replicator: source filer meta-stream -> sink (weed/replication's
+Replicator + filer.replicate command role).
+
+Runs an optional bootstrap pass (recursive listing of the source tree,
+applied as creates — covers history older than the meta-log window),
+then follows ``SubscribeMetadata`` from just before the bootstrap
+snapshot so nothing written during the walk is missed; the sink's
+mtime/size idempotence absorbs the overlap. Reconnects with backoff on
+stream failure, resuming from the last applied event timestamp.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .. import pb
+from ..cluster.filer_client import FilerClient
+from ..cluster.master import _grpc_port
+from ..pb import filer_pb2
+from ..util import glog
+from .sinks import ReplicationSink
+
+
+class Replicator:
+    def __init__(self, source_filer_url: str, sink: ReplicationSink,
+                 path_prefix: str = "/",
+                 client_name: str = "replicator",
+                 bootstrap: bool = True):
+        self.source_url = source_filer_url
+        self.sink = sink
+        self.path_prefix = "/" + path_prefix.strip("/")
+        self.client_name = client_name
+        self.bootstrap = bootstrap
+        self.last_ts_ns = 0
+        self.applied = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._channel = None
+
+    # ------------- lifecycle -------------
+
+    def start(self) -> "Replicator":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="filer-replicator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._channel is not None:
+            self._channel.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.sink.close()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------- internals -------------
+
+    def _stub(self) -> pb.Stub:
+        import grpc
+
+        if self._channel is None:
+            ip, http_port = self.source_url.rsplit(":", 1)
+            self._channel = grpc.insecure_channel(
+                f"{ip}:{_grpc_port(int(http_port))}")
+        return pb.filer_stub(self._channel)
+
+    #: Clock-skew cushion for the bootstrap/stream seam: events are
+    #: stamped by the SOURCE filer's clock, so the resume point backs
+    #: off this much; the sink's signature idempotence makes the
+    #: resulting over-replay free.
+    SKEW_NS = 60 * 1_000_000_000
+
+    def _run(self) -> None:
+        need_bootstrap = self.bootstrap
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                if need_bootstrap:
+                    # Resume point BEFORE the walk (minus skew cushion)
+                    # so mutations racing the bootstrap are replayed.
+                    self.last_ts_ns = time.time_ns() - self.SKEW_NS
+                    self._bootstrap()
+                    need_bootstrap = False
+                self._follow()
+                backoff = 0.2
+            except Exception as e:  # noqa: BLE001 — reconnect
+                if self._stop.is_set():
+                    return
+                if "window expired" in str(e):
+                    # Source's meta-log no longer covers our resume
+                    # point: replay alone cannot converge — full
+                    # re-sync, even for noBootstrap replicators.
+                    glog.warning("replication: resume window expired; "
+                                 "re-syncing the tree")
+                    need_bootstrap = True
+                glog.v(1, "replication stream broke: %s", e)
+                # the channel may be the casualty — dial fresh next time
+                if self._channel is not None:
+                    try:
+                        self._channel.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._channel = None
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def _bootstrap(self) -> None:
+        src = FilerClient(self.source_url)
+        try:
+            stack = [self.path_prefix]
+            while stack and not self._stop.is_set():
+                d = stack.pop()
+                for e in src.list(d):
+                    p = (d.rstrip("/") + "/" + e.name)
+                    self._apply(p, e)  # per-entry errors never abort
+                    if e.is_directory:
+                        stack.append(p)
+        finally:
+            src.close()
+
+    def _apply(self, path: str, new_entry, old_entry=None) -> None:
+        try:
+            self.sink.apply(path, new_entry, old_entry)
+            self.applied += 1
+        except Exception as e:  # noqa: BLE001 — one bad entry, not all
+            self.errors += 1
+            glog.warning("replication: apply %s failed: %s", path, e)
+
+    def _follow(self) -> None:
+        # Resume one tick early: the filer's replay filter is strictly
+        # ``>``, and two mutations can share a coarse-clock timestamp —
+        # an equal-ts event after the last applied one must not be
+        # skipped (re-applying the applied one is free via the sink's
+        # signature check).
+        stream = self._stub().SubscribeMetadata(
+            filer_pb2.SubscribeMetadataRequest(
+                client_name=self.client_name,
+                path_prefix=self.path_prefix,
+                since_ns=max(0, self.last_ts_ns - 1)))
+        for resp in stream:
+            if self._stop.is_set():
+                return
+            note = resp.event_notification
+            new = note.new_entry if note.new_entry.name else None
+            old = note.old_entry if note.old_entry.name else None
+            name = (new or old).name if (new or old) else ""
+            if not name:
+                continue
+            path = resp.directory.rstrip("/") + "/" + name
+            self._apply(path, new, old)
+            self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """``python -m seaweedfs_tpu filer.replicate`` — follow one filer
+    into another (weed filer.replicate analog)."""
+    import argparse
+
+    from .sinks import FilerSink
+
+    p = argparse.ArgumentParser(prog="filer.replicate")
+    p.add_argument("-from", dest="src", required=True,
+                   help="source filer host:port")
+    p.add_argument("-to", dest="dst", required=True,
+                   help="destination filer host:port")
+    p.add_argument("-path", default="/",
+                   help="replicate only this subtree")
+    p.add_argument("-noBootstrap", action="store_true",
+                   help="skip the initial full-tree sync")
+    args = p.parse_args(argv)
+    rep = Replicator(args.src, FilerSink(args.src, args.dst),
+                     path_prefix=args.path,
+                     bootstrap=not args.noBootstrap).start()
+    glog.info("replicating %s -> %s (prefix %s)", args.src, args.dst,
+              args.path)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        rep.stop()
+    return 0

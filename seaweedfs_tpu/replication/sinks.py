@@ -1,0 +1,103 @@
+"""Replication sinks (weed/replication/sink analog).
+
+``ReplicationSink`` is the seam the reference fans out to (filer, S3,
+GCS, Azure...); ``FilerSink`` is the filer->filer implementation: it
+mirrors namespace mutations and copies file content so destination
+entries own fresh chunks in the destination cluster — replicating raw
+chunk fids would point into the SOURCE cluster's volumes and turn a
+source-side vacuum or volume loss into silent remote data loss.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..cluster.filer_client import FilerClient, FilerClientError
+from ..util import glog
+
+
+class ReplicationSink:
+    """One replication target. ``apply`` receives the source path and
+    the entry's new state (None = deleted)."""
+
+    def apply(self, path: str, new_entry, old_entry=None) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FilerSink(ReplicationSink):
+    def __init__(self, source: FilerClient | str,
+                 destination: FilerClient | str,
+                 dst_prefix: str = "/"):
+        self.src = source if isinstance(source, FilerClient) \
+            else FilerClient(source)
+        self.dst = destination if isinstance(destination, FilerClient) \
+            else FilerClient(destination)
+        self.dst_prefix = "/" + dst_prefix.strip("/")
+
+    def _dst_path(self, path: str) -> str:
+        if self.dst_prefix == "/":
+            return path
+        return self.dst_prefix + path
+
+    @staticmethod
+    def _src_signature(entry) -> bytes:
+        """Identity of the SOURCE entry's content: its chunk manifest.
+        Chunk fids change on every source write (appends mint new fids),
+        so this distinguishes same-size same-second overwrites that an
+        (mtime, size) check cannot."""
+        sig = ";".join(f"{c.file_id}@{c.offset}+{c.size}"
+                       for c in entry.chunks)
+        return sig.encode()
+
+    def apply(self, path: str, new_entry, old_entry=None) -> None:
+        dst_path = self._dst_path(path)
+        if new_entry is None:
+            try:
+                self.dst.delete_data(dst_path)
+            except FilerClientError as e:
+                glog.v(1, "replication: delete %s: %s", dst_path, e)
+            return
+        d, _, n = dst_path.rpartition("/")
+        if new_entry.is_directory:
+            self.dst.mkdir(d or "/", n)
+            # carry the directory's mode/xattrs like the file path does
+            dup = self.dst.lookup(d or "/", n)
+            if dup is not None and (new_entry.attributes.file_mode
+                                    or new_entry.extended):
+                if new_entry.attributes.file_mode:
+                    dup.attributes.file_mode = \
+                        new_entry.attributes.file_mode
+                for k, v in new_entry.extended.items():
+                    dup.extended[k] = v
+                self.dst.create(d or "/", dup)
+            return
+        size = max(new_entry.attributes.file_size,
+                   max((c.offset + c.size for c in new_entry.chunks),
+                       default=0))
+        # Idempotence: the destination entry remembers which source
+        # chunk manifest it was copied from; matching signature = same
+        # content, skip (bootstrap + replay overlap is then free).
+        sig = self._src_signature(new_entry)
+        existing = self.dst.lookup(d or "/", n)
+        if existing is not None and not existing.is_directory and \
+                existing.extended.get("replication.src_sig") == sig:
+            return
+        data = self.src.get_data(path) if size else b""
+        self.dst.put_data(dst_path, data,
+                          mime=new_entry.attributes.mime)
+        # carry attributes (mode, mtime) + the signature onto the entry
+        dup = self.dst.lookup(d or "/", n)
+        if dup is not None:
+            dup.attributes.file_mode = new_entry.attributes.file_mode
+            dup.attributes.mtime = new_entry.attributes.mtime
+            for k, v in new_entry.extended.items():
+                dup.extended[k] = v
+            dup.extended["replication.src_sig"] = sig
+            self.dst.create(d or "/", dup)
+
+    def close(self) -> None:
+        self.src.close()
+        self.dst.close()

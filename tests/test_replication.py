@@ -1,0 +1,222 @@
+"""Filer->filer replication: meta-log replay + replicator convergence
+(weed/replication + filer_notify.go analogs)."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.filer_client import FilerClient
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.replication import FilerSink, Replicator
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+def _wait_for(pred, timeout=15.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def two_filers(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=5,
+                          garbage_threshold=0).start()
+    d = tmp_path_factory.mktemp("repvol")
+    store = Store([d], max_volumes=16)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url,
+                      pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 1:
+        time.sleep(0.05)
+    fa = FilerServer(Filer(), port=_free_port_pair(),
+                     master_url=master.url).start()
+    fb = FilerServer(Filer(), port=_free_port_pair(),
+                     master_url=master.url).start()
+    yield fa, fb
+    fb.stop()
+    fa.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_meta_log_replay_since(two_filers):
+    import threading
+
+    fa, _ = two_filers
+    fc = FilerClient(fa.url)
+    try:
+        t0 = time.time_ns()
+        fc.put_data("/log/one.txt", b"1")
+        fc.put_data("/log/two.txt", b"22")
+        # replay from before both writes — no live subscriber existed
+        evs = []
+        stop = threading.Event()
+
+        def collect():
+            for ev in fa.filer.subscribe(stop=stop, since_ns=t0):
+                evs.append(ev)
+
+        t = threading.Thread(target=collect, daemon=True)
+        t.start()
+        _wait_for(lambda: len(evs) >= 3, what="replayed events")
+        stop.set()
+        t.join(timeout=5)
+        names = {ev.new_entry.path for ev in evs
+                 if ev.new_entry is not None}
+        assert "/log/one.txt" in names and "/log/two.txt" in names
+    finally:
+        fc.close()
+
+
+def test_two_filers_converge(two_filers):
+    fa, fb = two_filers
+    ca, cb = FilerClient(fa.url), FilerClient(fb.url)
+    rep = None
+    try:
+        # pre-existing data (bootstrap must cover it)
+        ca.put_data("/site/a.txt", b"alpha")
+        ca.put_data("/site/deep/b.bin", bytes(range(256)) * 100)
+        rep = Replicator(fa.url, FilerSink(ca, cb),
+                         path_prefix="/").start()
+        _wait_for(lambda: cb.lookup("/site", "a.txt") is not None,
+                  what="bootstrap of a.txt")
+        _wait_for(lambda: cb.lookup("/site/deep", "b.bin") is not None,
+                  what="bootstrap of deep/b.bin")
+        assert cb.get_data("/site/a.txt") == b"alpha"
+        assert cb.get_data("/site/deep/b.bin") == bytes(range(256)) * 100
+
+        # live writes converge
+        ca.put_data("/site/c.txt", b"gamma")
+        _wait_for(lambda: cb.lookup("/site", "c.txt") is not None,
+                  what="live create")
+        assert cb.get_data("/site/c.txt") == b"gamma"
+
+        # overwrite converges
+        ca.put_data("/site/a.txt", b"alpha-v2")
+        _wait_for(lambda: _content(cb, "/site/a.txt") == b"alpha-v2",
+                  what="live overwrite")
+
+        # rename converges (delete + create events)
+        ca.rename("/site", "c.txt", "/site", "c2.txt")
+        _wait_for(lambda: cb.lookup("/site", "c2.txt") is not None
+                  and cb.lookup("/site", "c.txt") is None,
+                  what="rename convergence")
+        assert cb.get_data("/site/c2.txt") == b"gamma"
+
+        # delete converges
+        ca.delete_data("/site/a.txt")
+        _wait_for(lambda: cb.lookup("/site", "a.txt") is None,
+                  what="delete convergence")
+        assert rep.errors == 0
+    finally:
+        if rep is not None:
+            rep.stop()
+        ca.close()
+        cb.close()
+
+
+def _content(client, path):
+    try:
+        return client.get_data(path)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def test_replicator_resumes_after_stream_break(two_filers):
+    fa, fb = two_filers
+    ca, cb = FilerClient(fa.url), FilerClient(fb.url)
+    rep = Replicator(fa.url, FilerSink(ca, cb), path_prefix="/resume",
+                     bootstrap=False).start()
+    try:
+        ca.put_data("/resume/x.txt", b"x1")
+        _wait_for(lambda: cb.lookup("/resume", "x.txt") is not None,
+                  what="first replication")
+        # Break the stream; events during the outage must replay from
+        # the meta-log when the replicator reconnects.
+        rep._channel.close()
+        ca.put_data("/resume/y.txt", b"y1")
+        _wait_for(lambda: cb.lookup("/resume", "y.txt") is not None,
+                  what="post-outage catch-up")
+        assert cb.get_data("/resume/y.txt") == b"y1"
+    finally:
+        rep.stop()
+        ca.close()
+        cb.close()
+
+
+def test_meta_log_gap_detection(two_filers):
+    import collections
+
+    fa, _ = two_filers
+    filer = Filer()
+    filer._meta_log = collections.deque(maxlen=4)
+    filer.META_LOG_EVENTS = 4
+    t0 = time.time_ns()
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+    for i in range(8):  # wrap the window
+        filer.create_entry(Entry(path=f"/gap/f{i}", attr=Attr()))
+    assert not filer.meta_log_covers(t0)
+    from seaweedfs_tpu.filer.filer import FilerError
+    with pytest.raises(FilerError, match="window expired"):
+        next(iter(filer.subscribe(since_ns=t0)))
+    # a fresh (live-only) subscribe still works
+    assert filer.meta_log_covers(time.time_ns())
+
+
+def test_replicator_resyncs_after_window_expiry(two_filers):
+    import collections
+
+    fa, fb = two_filers
+    ca, cb = FilerClient(fa.url), FilerClient(fb.url)
+    # Shrink the source's replay window to force expiry during outage.
+    old_log = fa.filer._meta_log
+    fa.filer._meta_log = collections.deque(old_log, maxlen=8)
+    old_n = fa.filer.META_LOG_EVENTS
+    fa.filer.META_LOG_EVENTS = 8
+    rep = Replicator(fa.url, FilerSink(ca, cb), path_prefix="/exp",
+                     bootstrap=False).start()
+    try:
+        ca.put_data("/exp/first.txt", b"1")
+        _wait_for(lambda: cb.lookup("/exp", "first.txt") is not None,
+                  what="first replication")
+        rep._channel.close()  # outage
+        for i in range(12):   # overflow the window during the outage
+            ca.put_data(f"/exp/burst{i}.txt", b"b")
+        # the replicator must detect the gap and re-sync the tree
+        _wait_for(lambda: all(
+            cb.lookup("/exp", f"burst{i}.txt") is not None
+            for i in range(12)), what="re-sync after window expiry")
+    finally:
+        rep.stop()
+        fa.filer._meta_log = old_log
+        fa.filer.META_LOG_EVENTS = old_n
+        ca.close()
+        cb.close()
