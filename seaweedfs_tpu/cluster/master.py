@@ -27,7 +27,7 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import pb
 from ..pb import master_pb2, volume_server_pb2
-from ..storage.superblock import ReplicaPlacement
+from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..util import config as config_mod
 from ..util import glog
@@ -90,6 +90,7 @@ class MasterServer:
         self._http_thread: Optional[threading.Thread] = None
         self._reaper: Optional[threading.Thread] = None
         self._vacuum_thread: Optional[threading.Thread] = None
+        self._ttl_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._grow_lock = threading.Lock()
 
@@ -176,6 +177,15 @@ class MasterServer:
             for url in dead:
                 glog.warning("master: data node %s missed heartbeats, "
                              "removed from topology", url)
+            if self.is_leader and (self._ttl_thread is None or
+                                   not self._ttl_thread.is_alive()):
+                # Off the reap thread: a hung VolumeDelete must not
+                # stall dead-node detection (same rationale as the
+                # vacuum scan below).
+                self._ttl_thread = threading.Thread(
+                    target=self._reap_ttl_safe, daemon=True,
+                    name="master-ttl-reap")
+                self._ttl_thread.start()
             tick += 1
             if self.garbage_threshold > 0 and self.is_leader \
                     and tick % vacuum_every == 0 \
@@ -187,6 +197,51 @@ class MasterServer:
                     target=self._scan_and_vacuum_safe, daemon=True,
                     name="master-vacuum-scan")
                 self._vacuum_thread.start()
+
+    def _reap_ttl_safe(self) -> None:
+        try:
+            self.reap_expired_ttl_volumes()
+        except Exception as e:  # noqa: BLE001 — keep the scan cadence
+            glog.warning("master: ttl reap failed: %s", e)
+
+    def reap_expired_ttl_volumes(self) -> int:
+        """Topology TTL maintenance (weed/topology/ TTL reaping role):
+        a TTL volume whose last write is older than its TTL is deleted
+        from every replica server — the needles inside are all expired
+        by definition, so the whole volume goes at once (that is the
+        point of per-TTL volumes). Returns volumes reaped.
+
+        The deadline carries a grace margin beyond the TTL: the mtime
+        seen here is from the last heartbeat (stale by up to a pulse),
+        so reaping exactly at TTL could destroy a just-acknowledged
+        write the next heartbeat would have reported."""
+        now = time.time()
+        grace = max(10 * self.topology.pulse_seconds, 30.0)
+        reaped = 0
+        for node in self.topology.snapshot_nodes():
+            for v in list(node.volumes.values()):
+                if not v.ttl:
+                    continue
+                ttl_s = Ttl.parse(v.ttl).seconds
+                if not ttl_s or not v.modified_at_second:
+                    continue
+                if now - v.modified_at_second <= ttl_s + grace:
+                    continue
+                glog.info("master: volume %d on %s expired "
+                          "(ttl %s, idle %.0fs); deleting", v.id,
+                          node.url, v.ttl, now - v.modified_at_second)
+                try:
+                    self._volume_stub(node.url).VolumeDelete(
+                        volume_server_pb2.VolumeDeleteRequest(
+                            volume_id=v.id, collection=v.collection),
+                        timeout=30)
+                    self.topology.unregister_volume(node.url, v.id,
+                                                    v.collection)
+                    reaped += 1
+                except Exception as e:  # noqa: BLE001 — next scan retries
+                    glog.warning("master: ttl delete of volume %d on "
+                                 "%s failed: %s", v.id, node.url, e)
+        return reaped
 
     def _scan_and_vacuum_safe(self) -> None:
         try:
@@ -342,7 +397,9 @@ class _MasterServicer:
                 replica_placement=str(
                     ReplicaPlacement.from_byte(v.replica_placement)),
                 version=v.version or 3,
-                ttl="" if not v.ttl else str(v.ttl),
+                ttl="" if not v.ttl else str(Ttl.from_bytes(
+                    v.ttl.to_bytes(2, "big"))),
+                modified_at_second=v.modified_at_second,
             ) for v in hb.volumes]
             ec = [(s.collection, s.id, s.ec_index_bits)
                   for s in hb.ec_shards]

@@ -36,6 +36,8 @@ class VolumeInfo:
     replica_placement: str = "000"
     version: int = 3
     ttl: str = ""
+    #: Last .dat mtime (unix seconds); drives topology TTL reaping.
+    modified_at_second: int = 0
 
 
 @dataclass
@@ -153,6 +155,17 @@ class Topology:
                 raise TopologyError(f"unknown data node {url}")
             node.volumes[(info.collection, info.id)] = info
             self.max_volume_id = max(self.max_volume_id, info.id)
+            self._rebuild_indexes()
+
+    def unregister_volume(self, url: str, volume_id: int,
+                          collection: str = "") -> None:
+        """Drop one volume from a node immediately (TTL reap / delete);
+        the next heartbeat snapshot confirms the removal."""
+        with self._lock:
+            node = self.nodes.get(url)
+            if node is None:
+                return
+            node.volumes.pop((collection, volume_id), None)
             self._rebuild_indexes()
 
     def snapshot_nodes(self) -> list[DataNode]:

@@ -34,7 +34,7 @@ from ..pipeline.scheme import DEFAULT_SCHEME, EcScheme
 from ..storage import ec_files
 from ..storage.needle import Needle
 from ..storage.store import Store, StoreError
-from ..storage.superblock import ReplicaPlacement
+from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
 from ..util import glog, security
@@ -233,7 +233,10 @@ class VolumeServer:
                 read_only=v["read_only"],
                 replica_placement=ReplicaPlacement.parse(
                     v["replica_placement"]).to_byte(),
-                version=v.get("version", 3))
+                version=v.get("version", 3),
+                ttl=int.from_bytes(
+                    Ttl.parse(v.get("ttl", "")).to_bytes(), "big"),
+                modified_at_second=v.get("modified_at_second", 0))
         for s in st["ec_shards"]:
             hb.ec_shards.add(id=s["id"], collection=s["collection"],
                              ec_index_bits=s["ec_index_bits"])
@@ -885,13 +888,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("-rack", default="")
     p.add_argument("-publicUrl", default="")
     p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-index", default="memory",
+                   choices=["memory", "sqlite"],
+                   help="needle map kind (sqlite = disk-backed, for "
+                        "volumes whose index exceeds RAM)")
+    p.add_argument("-backend", default="disk",
+                   choices=["disk", "mmap"],
+                   help=".dat storage backend")
     p.add_argument("-config", default="",
                    help="security.toml for the shared JWT signing key")
     args = p.parse_args(argv)
     from ..util import config as config_mod
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
-    store = Store(args.dir, max_volumes=args.max)
+    store = Store(args.dir, max_volumes=args.max, backend=args.backend,
+                  needle_map=args.index)
     store.load_existing()
     vs = VolumeServer(store, ip=args.ip, port=args.port,
                       master_url=args.mserver, public_url=args.publicUrl,

@@ -102,10 +102,15 @@ class Store:
     """The storage engine facade the volume server drives (store.go)."""
 
     def __init__(self, locations: list[str | Path],
-                 max_volumes: int = 8):
+                 max_volumes: int = 8, backend: str = "disk",
+                 needle_map: str = "memory"):
         if not locations:
             raise StoreError("a store needs at least one disk location")
         self.locations = [DiskLocation(d, max_volumes) for d in locations]
+        #: .dat backend kind (storage/backend.py registry) and needle
+        #: map kind ("memory" | "sqlite") applied to every volume.
+        self.backend = backend
+        self.needle_map = needle_map
         self.volumes: dict[tuple[str, int], Volume] = {}
         self.ec_mounts: dict[tuple[str, int], EcVolumeMount] = {}
         self.readonly: set[tuple[str, int]] = set()
@@ -119,7 +124,9 @@ class Store:
         for loc in self.locations:
             for col, vid, base in loc.scan_volumes():
                 if (col, vid) not in self.volumes:
-                    self.volumes[(col, vid)] = Volume(base, vid).load()
+                    self.volumes[(col, vid)] = Volume(
+                        base, vid, backend=self.backend,
+                        needle_map=self.needle_map).load()
             for col, vid, base, ids in loc.scan_ec_shards():
                 m = self.ec_mounts.setdefault(
                     (col, vid), EcVolumeMount(base, col, vid))
@@ -156,7 +163,8 @@ class Store:
             replica_placement=ReplicaPlacement.parse(replica_placement),
             ttl=Ttl.parse(ttl))
         vol = Volume(loc.base_for(volume_id, collection), volume_id,
-                     sb).create()
+                     sb, backend=self.backend,
+                     needle_map=self.needle_map).create()
         self.volumes[key] = vol
         return vol
 
@@ -336,6 +344,10 @@ class Store:
         the payload SendHeartbeat streams to the master."""
         vols = []
         for (col, vid), v in sorted(self.volumes.items()):
+            try:
+                modified = int(dat_path(v.base).stat().st_mtime)
+            except OSError:
+                modified = 0
             vols.append({
                 "id": vid, "collection": col,
                 "size": v.dat_size, "file_count": v.nm.file_count,
@@ -344,6 +356,8 @@ class Store:
                 "read_only": (col, vid) in self.readonly,
                 "replica_placement": str(v.super_block.replica_placement),
                 "version": v.super_block.version,
+                "ttl": str(v.super_block.ttl),
+                "modified_at_second": modified,
             })
         ec = [{"id": vid, "collection": col,
                "ec_index_bits": m.shard_bits.bits}

@@ -86,6 +86,14 @@ class Ttl:
     def __str__(self) -> str:
         return "" if self.count == 0 else f"{self.count}{self.unit}"
 
+    @property
+    def seconds(self) -> int:
+        """TTL duration in seconds (0 = no expiry), volume_ttl.go's
+        Minutes()*60 equivalent."""
+        per = {"": 0, "m": 60, "h": 3600, "d": 86400, "w": 7 * 86400,
+               "M": 30 * 86400, "y": 365 * 86400}
+        return self.count * per.get(self.unit, 60)
+
 
 @dataclass
 class SuperBlock:

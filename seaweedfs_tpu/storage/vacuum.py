@@ -29,6 +29,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
 
+from . import backend as backend_mod
 from . import needle as needle_mod
 from .idx import CompactMap, IndexEntry, walk_index_blob
 from .superblock import SuperBlock
@@ -101,11 +102,10 @@ def _compact_locked(vol: Volume) -> CompactState:
         replica_placement=vol.super_block.replica_placement,
         ttl=vol.super_block.ttl,
         compact_revision=(vol.super_block.compact_revision + 1) & 0xFFFF)
-    dat_fd = vol._dat.fileno()
     with open(cpd_path(vol.base), "wb") as nd, \
             open(cpx_path(vol.base), "wb") as nx:
         nd.write(new_super.to_bytes())
-        _copy_live(snap, dat_fd, vol.super_block.version, nd, nx)
+        _copy_live(snap, vol._dat, vol.super_block.version, nd, nx)
         nd.flush()
         os.fsync(nd.fileno())
         nx.flush()
@@ -114,7 +114,7 @@ def _compact_locked(vol: Volume) -> CompactState:
                         new_super=new_super)
 
 
-def _copy_live(snap: CompactMap, dat_fd: int, version: int, nd, nx
+def _copy_live(snap: CompactMap, dat, version: int, nd, nx
                ) -> None:
     """Append every live needle of ``snap`` to nd/.cpx in offset order
     (preserves locality and keeps the copy sequential on disk)."""
@@ -123,7 +123,7 @@ def _copy_live(snap: CompactMap, dat_fd: int, version: int, nd, nx
         key=lambda e: e.offset_units)
     for e in entries:
         rec_size = needle_mod.record_size(e.size, version)
-        rec = os.pread(dat_fd, rec_size, e.byte_offset)
+        rec = dat.read_at(rec_size, e.byte_offset)
         if len(rec) < rec_size:
             raise VolumeError(
                 f"short read compacting needle {e.key}")
@@ -182,7 +182,6 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
             with open(idx_path(vol.base), "rb") as f:
                 f.seek(state.idx_snapshot_bytes)
                 diff = f.read(idx_now - state.idx_snapshot_bytes)
-            dat_fd = vol._dat.fileno()
             for e in walk_index_blob(diff):
                 if e.is_deleted:
                     nx.write(IndexEntry(
@@ -190,7 +189,7 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
                     continue
                 rec_size = needle_mod.record_size(
                     e.size, vol.super_block.version)
-                rec = os.pread(dat_fd, rec_size, e.byte_offset)
+                rec = vol._dat.read_at(rec_size, e.byte_offset)
                 if len(rec) < rec_size:
                     raise VolumeError(
                         f"short read replaying diff for needle "
@@ -216,9 +215,9 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
     except OSError:
         # Nothing swapped yet: reopen the untouched live files so the
         # volume stays serviceable; abort_compact discards .cpd/.cpx.
-        vol._dat = open(dat_path(vol.base), "r+b")
+        vol._dat = backend_mod.open_backend(vol.backend_kind,
+                                            dat_path(vol.base))
         vol._idx = open(idx_path(vol.base), "a+b")
-        vol._dat.seek(0, 2)
         raise
     try:
         os.replace(cpx_path(vol.base), idx_path(vol.base))
@@ -228,13 +227,15 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
         # take the volume out of service — the next load() installs it.
         vol._dat = vol._idx = None
         raise
-    vol._dat = open(dat_path(vol.base), "r+b")
+    vol._dat = backend_mod.open_backend(vol.backend_kind,
+                                        dat_path(vol.base))
     vol._idx = open(idx_path(vol.base), "a+b")
     vol.super_block = state.new_super
-    vol.nm = CompactMap.load_from_idx(idx_path(vol.base))
-    vol._dat.seek(0, 2)
+    if hasattr(vol.nm, "close"):
+        vol.nm.close()
+    vol.nm = vol._load_needle_map()
     vol.vacuum_in_progress = False
-    return vol._dat.tell()
+    return vol._dat.size()
 
 
 def cleanup(base: str | Path) -> None:

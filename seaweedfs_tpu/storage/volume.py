@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from . import backend as backend_mod
 from . import needle as needle_mod
 from .idx import CompactMap, IndexEntry, walk_index_blob
 from .superblock import SuperBlock
@@ -44,15 +45,23 @@ def idx_path(base: str | Path) -> Path:
 
 class Volume:
     """A single writable/readable volume addressed by its base path
-    (``<dir>/<collection_>?<vid>`` without extension)."""
+    (``<dir>/<collection_>?<vid>`` without extension).
+
+    ``backend`` selects the .dat storage implementation by name
+    (storage/backend.py registry: "disk", "mmap", ...); ``needle_map``
+    selects the index implementation ("memory" CompactMap or the
+    disk-backed "sqlite" map for volumes whose index exceeds RAM)."""
 
     def __init__(self, base: str | Path, volume_id: int = 0,
-                 super_block: Optional[SuperBlock] = None):
+                 super_block: Optional[SuperBlock] = None,
+                 backend: str = "disk", needle_map: str = "memory"):
         self.base = Path(base)
         self.volume_id = volume_id
         self.super_block = super_block or SuperBlock()
+        self.backend_kind = backend
+        self.needle_map_kind = needle_map
         self.nm = CompactMap()
-        self._dat = None
+        self._dat: Optional[backend_mod.BackendStorageFile] = None
         self._idx = None
         #: Guard: at most one compaction in flight (storage/vacuum.py).
         self.vacuum_in_progress = False
@@ -77,10 +86,32 @@ class Volume:
     def create(self) -> "Volume":
         if dat_path(self.base).exists():
             raise VolumeError(f"{dat_path(self.base)} already exists")
-        self._dat = open(dat_path(self.base), "w+b")
-        self._dat.write(self.super_block.to_bytes())
+        self._dat = backend_mod.open_backend(
+            self.backend_kind, dat_path(self.base), create=True)
+        self._dat.append(self.super_block.to_bytes())
         self._idx = open(idx_path(self.base), "w+b")
+        self.nm = self._new_needle_map()
         return self
+
+    def _new_needle_map(self):
+        if self.needle_map_kind == "memory":
+            return CompactMap()
+        if self.needle_map_kind == "sqlite":
+            from .needle_map_sqlite import SqliteNeedleMap
+            return SqliteNeedleMap(
+                str(self.base) + ".sdx",
+                generation=self.super_block.compact_revision)
+        raise VolumeError(
+            f"unknown needle map kind {self.needle_map_kind!r}")
+
+    def _load_needle_map(self):
+        ip = idx_path(self.base)
+        if self.needle_map_kind == "memory":
+            return CompactMap.load_from_idx(ip)
+        from .needle_map_sqlite import SqliteNeedleMap
+        return SqliteNeedleMap.load_from_idx(
+            str(self.base) + ".sdx", ip,
+            generation=self.super_block.compact_revision)
 
     def load(self) -> "Volume":
         p = dat_path(self.base)
@@ -103,17 +134,21 @@ class Volume:
             for leftover in (cpd, cpx):
                 if leftover.exists():
                     leftover.unlink()
-        self._dat = open(p, "r+b")
-        head = self._dat.read(8)
+        self._dat = backend_mod.open_backend(self.backend_kind, p)
+        head = self._dat.read_at(8, 0)
         if len(head) < 8:
             raise VolumeError(f"{p} shorter than a superblock")
         extra_len = struct.unpack_from(">H", head, 6)[0]
-        self.super_block = SuperBlock.parse(head + self._dat.read(extra_len))
-        check_volume_data_integrity(self.base, self.super_block)
+        self.super_block = SuperBlock.parse(
+            head + self._dat.read_at(extra_len, 8))
+        repairs = check_volume_data_integrity(self.base, self.super_block)
+        if repairs.get("dat_truncated_bytes"):
+            # the check truncated the file underneath the open backend
+            self._dat.close()
+            self._dat = backend_mod.open_backend(self.backend_kind, p)
         ip = idx_path(self.base)
         self._idx = open(ip, "a+b") if ip.exists() else open(ip, "w+b")
-        self.nm = CompactMap.load_from_idx(ip)
-        self._dat.seek(0, 2)
+        self.nm = self._load_needle_map()
         return self
 
     def close(self) -> None:
@@ -121,6 +156,8 @@ class Volume:
             if f is not None:
                 f.close()
         self._dat = self._idx = None
+        if hasattr(self.nm, "close"):
+            self.nm.close()
 
     def __enter__(self):
         return self
@@ -136,16 +173,15 @@ class Volume:
         if self._dat is None:
             raise VolumeError("volume not open")
         with self._lock:
-            self._dat.seek(0, 2)
-            offset = self._dat.tell()
+            offset = self._dat.size()
             if offset % NEEDLE_PADDING_SIZE:
                 pad = (-offset) % NEEDLE_PADDING_SIZE
-                self._dat.write(b"\x00" * pad)
+                self._dat.write_at(b"\x00" * pad, offset)
                 offset += pad
             rec = n.to_bytes(self.super_block.version)
             body_size = needle_mod.parse_header(rec)[2]
-            self._dat.write(rec)
-            # Flush to the OS so concurrent pread()s see the record the
+            self._dat.write_at(rec, offset)
+            # Flush to the OS so concurrent reads see the record the
             # moment the index entry is visible.
             self._dat.flush()
             units = to_offset_units(offset)
@@ -164,11 +200,10 @@ class Volume:
                 raise KeyError(f"needle {key} not found")
             if self._dat is None:
                 raise VolumeError("volume not open")
-            fd = self._dat.fileno()
+            dat = self._dat
             self._readers += 1
         try:
-            rec = os.pread(
-                fd,
+            rec = dat.read_at(
                 needle_mod.record_size(entry.size,
                                        self.super_block.version),
                 entry.byte_offset)
@@ -196,16 +231,16 @@ class Volume:
 
     def sync(self) -> None:
         with self._lock:
-            for f in (self._dat, self._idx):
-                if f is not None:
-                    f.flush()
-                    os.fsync(f.fileno())
+            if self._dat is not None:
+                self._dat.sync()
+            if self._idx is not None:
+                self._idx.flush()
+                os.fsync(self._idx.fileno())
 
     @property
     def dat_size(self) -> int:
         with self._lock:
-            self._dat.seek(0, 2)
-            return self._dat.tell()
+            return self._dat.size()
 
     def content_size(self) -> int:
         return self.dat_size

@@ -1,0 +1,225 @@
+"""Backend seam (disk/mmap) + sqlite needle map
+(weed/storage/backend + needle_map_leveldb.go analogs)."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.backend import DiskFile, MmapFile, open_backend
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map_sqlite import SqliteNeedleMap
+from seaweedfs_tpu.storage.volume import (Volume,
+                                          generate_synthetic_volume,
+                                          idx_path)
+
+
+# ---------------- backend unit ----------------
+
+@pytest.mark.parametrize("factory", [DiskFile, MmapFile])
+def test_backend_rw_roundtrip(tmp_path, factory):
+    p = tmp_path / "f.dat"
+    b = factory(p, create=True)
+    off = b.append(b"hello")
+    assert off == 0
+    assert b.append(b"world") == 5
+    assert b.read_at(10, 0) == b"helloworld"
+    assert b.read_at(5, 5) == b"world"
+    b.write_at(b"WORLD", 5)
+    assert b.read_at(10, 0) == b"helloWORLD"
+    assert b.size() == 10
+    b.truncate(5)
+    assert b.size() == 5
+    assert b.read_at(10, 0) == b"hello"
+    b.sync()
+    b.close()
+    # reopen existing
+    b2 = factory(p)
+    assert b2.read_at(5, 0) == b"hello"
+    b2.close()
+
+
+def test_open_backend_registry(tmp_path):
+    b = open_backend("mmap", tmp_path / "x.dat", create=True)
+    assert isinstance(b, MmapFile)
+    b.close()
+    with pytest.raises(ValueError, match="unknown backend"):
+        open_backend("s4", tmp_path / "y.dat")
+
+
+def test_mmap_reads_see_new_appends(tmp_path):
+    b = MmapFile(tmp_path / "m.dat", create=True)
+    b.append(b"a" * 4096)
+    assert b.read_at(10, 0) == b"a" * 10  # mapped
+    b.append(b"b" * 100)  # past the mapped frontier
+    assert b.read_at(5, 4096) == b"b" * 5  # triggers remap
+    b.close()
+
+
+# ---------------- volume over each backend/map --------------------
+
+@pytest.mark.parametrize("backend", ["disk", "mmap"])
+@pytest.mark.parametrize("nmap", ["memory", "sqlite"])
+def test_volume_roundtrip_all_combos(tmp_path, backend, nmap):
+    base = str(tmp_path / "1")
+    vol = Volume(base, 1, backend=backend, needle_map=nmap).create()
+    payloads = {}
+    for i in range(1, 31):
+        data = os.urandom(200 + i)
+        vol.write_needle(Needle(cookie=i, id=i, data=data))
+        payloads[i] = data
+    for i in (1, 15, 30):
+        assert vol.read_needle(i).data == payloads[i]
+    assert vol.delete_needle(7)
+    vol.close()
+    # reload and verify
+    vol2 = Volume(base, 1, backend=backend, needle_map=nmap).load()
+    for i in payloads:
+        if i == 7:
+            with pytest.raises(KeyError):
+                vol2.read_needle(i)
+        else:
+            assert vol2.read_needle(i).data == payloads[i]
+    assert vol2.nm.max_key == 30
+    vol2.close()
+
+
+def test_sqlite_map_vacuum_cycle(tmp_path):
+    base = str(tmp_path / "2")
+    vol = Volume(base, 2, needle_map="sqlite").create()
+    payloads = {}
+    for i in range(1, 41):
+        data = os.urandom(128)
+        vol.write_needle(Needle(cookie=1, id=i, data=data))
+        payloads[i] = data
+    for i in range(1, 21):
+        vol.delete_needle(i)
+    assert vacuum_mod.garbage_ratio(vol) > 0.3
+    new_size = vacuum_mod.vacuum(vol, threshold=0.3)
+    assert new_size is not None
+    for i in range(21, 41):
+        assert vol.read_needle(i).data == payloads[i]
+    vol.close()
+    # reload: watermark must detect the replaced .idx and rebuild
+    vol3 = Volume(base, 2, needle_map="sqlite").load()
+    for i in range(21, 41):
+        assert vol3.read_needle(i).data == payloads[i]
+    with pytest.raises(KeyError):
+        vol3.read_needle(3)
+    assert vacuum_mod.garbage_ratio(vol3) == 0.0
+    vol3.close()
+
+
+def test_sqlite_map_incremental_replay(tmp_path):
+    """Reload must replay only the .idx tail beyond the watermark."""
+    base = str(tmp_path / "3")
+    vol = Volume(base, 3, needle_map="sqlite").create()
+    for i in range(1, 11):
+        vol.write_needle(Needle(cookie=1, id=i, data=b"x" * 64))
+    vol.close()
+    # First reload writes watermark = idx size.
+    vol = Volume(base, 3, needle_map="sqlite").load()
+    for i in range(11, 16):
+        vol.write_needle(Needle(cookie=1, id=i, data=b"y" * 64))
+    vol.close()
+    m = SqliteNeedleMap.load_from_idx(
+        base + ".sdx", idx_path(base))
+    assert len(m) == 15
+    assert m.max_key == 15
+    assert m._applied_bytes == idx_path(base).stat().st_size
+    m.close()
+
+
+def test_sqlite_map_survives_corrupt_db(tmp_path):
+    base = str(tmp_path / "4")
+    vol = Volume(base, 4, needle_map="sqlite").create()
+    for i in range(1, 6):
+        vol.write_needle(Needle(cookie=1, id=i, data=b"z" * 32))
+    vol.close()
+    with open(base + ".sdx", "wb") as f:
+        f.write(b"not a sqlite file at all")
+    vol2 = Volume(base, 4, needle_map="sqlite").load()
+    assert len(vol2.nm) == 5
+    assert vol2.read_needle(3).data == b"z" * 32
+    vol2.close()
+
+
+def test_counters_match_compactmap_semantics(tmp_path):
+    """Same mutation sequence -> same counters on both map kinds."""
+    from seaweedfs_tpu.storage.idx import CompactMap
+
+    cm = CompactMap()
+    sm = SqliteNeedleMap(tmp_path / "c.sdx")
+    ops = [("set", 1, 10, 100), ("set", 2, 20, 200),
+           ("set", 1, 30, 150),  # overwrite
+           ("del", 2), ("del", 2),  # double delete
+           ("set", 3, 40, 50), ("del", 1)]
+    for op in ops:
+        if op[0] == "set":
+            cm.set(op[1], op[2], op[3])
+            sm.set(op[1], op[2], op[3])
+        else:
+            assert cm.delete(op[1]) == sm.delete(op[1])
+    for attr in ("file_count", "deleted_count", "deleted_bytes",
+                 "max_key", "max_offset_units"):
+        assert getattr(cm, attr) == getattr(sm, attr), attr
+    assert len(cm) == len(sm)
+    assert [e.key for e in cm.live_entries()] == \
+        [e.key for e in sm.live_entries()]
+    sm.close()
+
+
+def test_ttl_volume_reaped_by_master(tmp_path):
+    """An expired-TTL volume is deleted cluster-wide by the master scan
+    (weed/topology TTL maintenance)."""
+    import socket
+    import time as time_mod
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.store import Store
+
+    def free_pair():
+        for _ in range(50):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if p + 10000 > 65535:
+                continue
+            try:
+                with socket.socket() as s2:
+                    s2.bind(("127.0.0.1", p + 10000))
+                return p
+            except OSError:
+                continue
+        raise RuntimeError("no free port pair")
+
+    master = MasterServer(port=free_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=0.2, seed=9,
+                          garbage_threshold=0).start()
+    d = tmp_path / "v"
+    d.mkdir()
+    store = Store([d], max_volumes=8)
+    vs = VolumeServer(store, port=free_pair(), master_url=master.url,
+                      pulse_seconds=0.2).start()
+    try:
+        deadline = time_mod.time() + 10
+        while time_mod.time() < deadline and not master.topology.nodes:
+            time_mod.sleep(0.05)
+        store.create_volume(1, ttl="1m")
+        store.write_needle(1, Needle(cookie=1, id=1, data=b"ephemeral"))
+        vs.heartbeat_now()
+        # fresh volume: not reaped
+        assert master.reap_expired_ttl_volumes() == 0
+        # age it past its TTL by back-dating the .dat mtime
+        base = store.get_volume(1).base
+        old = time_mod.time() - 120
+        os.utime(str(base) + ".dat", (old, old))
+        vs.heartbeat_now()
+        assert master.reap_expired_ttl_volumes() == 1
+        assert not store.has_volume(1)
+        assert not os.path.exists(str(base) + ".dat")
+        assert master.topology.lookup_volume(1, "") == []
+    finally:
+        vs.stop()
+        master.stop()
