@@ -171,14 +171,20 @@ class MasterServer:
     def _reap_loop(self) -> None:
         vacuum_every = max(1, int(self.garbage_scan_seconds /
                                   max(self.topology.pulse_seconds, 0.01)))
+        # TTL expiry has minute granularity — a full-topology scan per
+        # pulse would be pure churn; once a minute matches the vacuum
+        # scan's throttling approach.
+        ttl_every = max(1, int(60.0 /
+                               max(self.topology.pulse_seconds, 0.01)))
         tick = 0
         while not self._stop.wait(self.topology.pulse_seconds):
             dead = self.topology.reap_dead_nodes()
             for url in dead:
                 glog.warning("master: data node %s missed heartbeats, "
                              "removed from topology", url)
-            if self.is_leader and (self._ttl_thread is None or
-                                   not self._ttl_thread.is_alive()):
+            if self.is_leader and tick % ttl_every == 0 \
+                    and (self._ttl_thread is None or
+                         not self._ttl_thread.is_alive()):
                 # Off the reap thread: a hung VolumeDelete must not
                 # stall dead-node detection (same rationale as the
                 # vacuum scan below).
@@ -308,7 +314,9 @@ class MasterServer:
         ch = self._channels.get(node_url)
         if ch is None:
             ip, http_port = node_url.rsplit(":", 1)
-            ch = grpc.insecure_channel(f"{ip}:{_grpc_port(int(http_port))}")
+            ch = security.grpc_auth_channel(
+                grpc.insecure_channel(
+                    f"{ip}:{_grpc_port(int(http_port))}"), self.guard)
             self._channels[node_url] = ch
         return pb.volume_stub(ch)
 

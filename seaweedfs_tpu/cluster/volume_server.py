@@ -117,8 +117,13 @@ class VolumeServer:
     def start(self) -> "VolumeServer":
         import grpc
 
+        # With a signing key, the whole gRPC plane (admin + EC reads)
+        # requires a cluster bearer token — the reference's gRPC TLS
+        # role (SURVEY.md §2 Security row), HMAC-keyed here.
+        auth = security.grpc_server_interceptor(self.guard)
         self._grpc_server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=16))
+            futures.ThreadPoolExecutor(max_workers=16),
+            interceptors=(auth,) if auth else ())
         self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
             pb.VOLUME_SERVICE, pb.VOLUME_METHODS, _VolumeServicer(self)),))
         bound = self._grpc_server.add_insecure_port(
@@ -171,8 +176,8 @@ class VolumeServer:
             ch = self._channels.get(url)
             if ch is None:
                 ip, http_port = url.rsplit(":", 1)
-                ch = grpc.insecure_channel(
-                    f"{ip}:{_grpc_port(int(http_port))}")
+                ch = security.grpc_auth_channel(grpc.insecure_channel(
+                    f"{ip}:{_grpc_port(int(http_port))}"), self.guard)
                 self._channels[url] = ch
             return ch
 

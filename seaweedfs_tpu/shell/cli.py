@@ -46,6 +46,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="local disk location (repeatable; offline mode)")
     p.add_argument("-master", default=None,
                    help="master ip:port (cluster mode)")
+    p.add_argument("-filer", default=None,
+                   help="filer ip:port (enables fs.* commands)")
+    p.add_argument("-config", default="",
+                   help="security.toml with the cluster signing key")
     p.add_argument("-maxVolumes", type=int, default=8)
     p.add_argument("-c", dest="oneshot", default=None,
                    help="run one command and exit")
@@ -56,7 +60,12 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     if args.master:
-        env = ClusterEnv(master_url=args.master)
+        from . import fs_commands  # noqa: F401 — registers fs.* commands
+        from ..util import config as config_mod
+        conf = config_mod.load(args.config) if args.config else {}
+        secret = config_mod.lookup(conf, "jwt.signing.key", "")
+        env = ClusterEnv(master_url=args.master, filer_url=args.filer,
+                         secret=secret)
         run = run_cluster_command
         cleanup = env.close
     else:

@@ -41,8 +41,13 @@ class ClusterEnv:
     """Dial info + cached stubs for one cluster (CommandEnv in shell/)."""
 
     master_url: str
+    filer_url: Optional[str] = None
+    #: Shared cluster signing key (security.toml jwt.signing.key); when
+    #: set, volume-server rpcs carry the cluster bearer token.
+    secret: str = ""
     out: io.TextIOBase = None  # type: ignore[assignment]
     _channels: dict = field(default_factory=dict)
+    _filer_client: object = None
 
     def __post_init__(self):
         if self.out is None:
@@ -52,20 +57,35 @@ class ClusterEnv:
     def println(self, *args) -> None:
         print(*args, file=self.out)
 
+    def filer_client(self):
+        """Lazy FilerClient for fs.* commands; None without -filer."""
+        if self.filer_url and self._filer_client is None:
+            from ..cluster.filer_client import FilerClient
+            self._filer_client = FilerClient(self.filer_url)
+        return self._filer_client
+
     def close(self) -> None:
         for ch in self._channels.values():
             ch.close()
         self._channels.clear()
+        if self._filer_client is not None:
+            self._filer_client.close()
+            self._filer_client = None
 
     # -- stubs --
 
     def _channel(self, url: str, grpc_offset: int = 10000):
         import grpc
 
+        from ..util import security
+
         ch = self._channels.get(url)
         if ch is None:
             ip, port = url.rsplit(":", 1)
             ch = grpc.insecure_channel(f"{ip}:{int(port) + grpc_offset}")
+            if self.secret:
+                ch = security.grpc_auth_channel(
+                    ch, security.Guard(self.secret))
             self._channels[url] = ch
         return ch
 

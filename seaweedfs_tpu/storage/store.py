@@ -198,7 +198,10 @@ class Store:
         vol.close()
         del self.volumes[(collection, volume_id)]
         self.readonly.discard((collection, volume_id))
-        for p in (dat_path(vol.base), idx_path(vol.base)):
+        # .sdx goes too: a leftover sqlite map would resurrect phantom
+        # index entries if the volume id is ever re-allocated.
+        for p in (dat_path(vol.base), idx_path(vol.base),
+                  Path(str(vol.base) + ".sdx")):
             if p.exists():
                 p.unlink()
 

@@ -70,3 +70,110 @@ class Guard:
                     and claims.get("exp", 0) >= time.time())
         except (ValueError, KeyError, json.JSONDecodeError):
             return False
+
+
+class _ClientCallDetails:
+    """Minimal grpc.ClientCallDetails carrier for the auth interceptor."""
+
+    __slots__ = ("method", "timeout", "metadata", "credentials",
+                 "wait_for_ready", "compression")
+
+    def __init__(self, base, metadata):
+        self.method = base.method
+        self.timeout = base.timeout
+        self.metadata = metadata
+        self.credentials = getattr(base, "credentials", None)
+        self.wait_for_ready = getattr(base, "wait_for_ready", None)
+        self.compression = getattr(base, "compression", None)
+
+
+def grpc_sign(guard: Guard, ttl: int = 60) -> str:
+    """Cluster-internal gRPC bearer token: same HS256 JWS as the write
+    path, scoped "grpc" instead of a fid (the reference secures this
+    plane with gRPC TLS; an env without cert plumbing uses the shared
+    signing key — weed/security's Guard role extended to admin/read
+    rpcs per SURVEY.md §2 Security)."""
+    if not guard.enabled:
+        return ""
+    header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64(json.dumps({
+        "scope": "grpc", "exp": int(time.time()) + ttl}).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = _b64(hmac.new(guard.key, signing_input, hashlib.sha256)
+               .digest())
+    return f"{header}.{payload}.{sig}"
+
+
+def grpc_verify(guard: Guard, token: str) -> bool:
+    if not guard.enabled:
+        return True
+    try:
+        header, payload, sig = token.split(".")
+        signing_input = f"{header}.{payload}".encode()
+        want = hmac.new(guard.key, signing_input, hashlib.sha256).digest()
+        if not hmac.compare_digest(want, _unb64(sig)):
+            return False
+        claims = json.loads(_unb64(payload))
+        return (claims.get("scope") == "grpc"
+                and claims.get("exp", 0) >= time.time())
+    except (ValueError, KeyError, json.JSONDecodeError):
+        return False
+
+
+def grpc_server_interceptor(guard: Guard):
+    """Server-side enforcement: every rpc must carry a valid bearer
+    token once a key is configured. Returns None when auth is off."""
+    import grpc
+
+    if not guard.enabled:
+        return None
+
+    def deny(request, context):
+        context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                      "missing or invalid grpc auth token")
+
+    deny_handler = grpc.unary_unary_rpc_method_handler(deny)
+
+    class _Auth(grpc.ServerInterceptor):
+        def intercept_service(self, continuation, details):
+            md = dict(details.invocation_metadata or ())
+            tok = md.get("authorization", "")
+            if tok.startswith("Bearer "):
+                tok = tok[len("Bearer "):]
+            if grpc_verify(guard, tok):
+                return continuation(details)
+            return deny_handler
+
+    return _Auth()
+
+
+def grpc_auth_channel(channel, guard: Guard):
+    """Client-side: wrap a channel so every call carries a fresh bearer
+    token. No-op when auth is off."""
+    import grpc
+
+    if not guard.enabled:
+        return channel
+
+    class _Attach(grpc.UnaryUnaryClientInterceptor,
+                  grpc.UnaryStreamClientInterceptor,
+                  grpc.StreamUnaryClientInterceptor,
+                  grpc.StreamStreamClientInterceptor):
+        def _details(self, cd):
+            md = list(cd.metadata or [])
+            md.append(("authorization", f"Bearer {grpc_sign(guard)}"))
+            return _ClientCallDetails(cd, md)
+
+        def intercept_unary_unary(self, cont, cd, req):
+            return cont(self._details(cd), req)
+
+        def intercept_unary_stream(self, cont, cd, req):
+            return cont(self._details(cd), req)
+
+        def intercept_stream_unary(self, cont, cd, it):
+            return cont(self._details(cd), it)
+
+        def intercept_stream_stream(self, cont, cd, it):
+            return cont(self._details(cd), it)
+
+    return grpc.intercept_channel(channel, _Attach())

@@ -223,3 +223,26 @@ def test_ttl_volume_reaped_by_master(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_recreated_volume_id_has_no_phantom_entries(tmp_path):
+    """delete_volume + create_volume with the same id (ec.encode's
+    source delete, TTL reap + re-allocation) must not resurrect index
+    entries from the dead volume's leftover sqlite map."""
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store([tmp_path], max_volumes=8, needle_map="sqlite")
+    store.create_volume(1)
+    for i in range(1, 6):
+        store.write_needle(1, Needle(cookie=1, id=i, data=b"old" * 10))
+    store.delete_volume(1)
+    assert not os.path.exists(str(tmp_path / "1") + ".sdx")
+    store.create_volume(1)
+    vol = store.get_volume(1)
+    assert len(vol.nm) == 0
+    assert vol.nm.file_count == 0
+    with pytest.raises(KeyError):
+        vol.read_needle(3)
+    store.write_needle(1, Needle(cookie=1, id=9, data=b"new"))
+    assert vol.read_needle(9).data == b"new"
+    store.close()

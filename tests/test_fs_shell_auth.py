@@ -1,0 +1,191 @@
+"""fs.* shell commands over a live filer + gRPC auth on the volume
+server admin/read plane (command_fs_*.go + weed/security TLS role)."""
+
+import io
+import json
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import pb
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.pb import volume_server_pb2
+from seaweedfs_tpu.shell import fs_commands  # noqa: F401 — registers
+from seaweedfs_tpu.shell.cluster_commands import (ClusterEnv,
+                                                  run_cluster_command)
+from seaweedfs_tpu.shell.commands import ShellError
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+SECRET = "cluster-test-key"
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=2, secret=SECRET,
+                          garbage_threshold=0).start()
+    d = tmp_path_factory.mktemp("fsvol")
+    store = Store([d], max_volumes=8)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, secret=SECRET,
+                      pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _shell(stack, line: str) -> str:
+    master, _, filer = stack
+    out = io.StringIO()
+    env = ClusterEnv(master_url=master.url, filer_url=filer.url,
+                     secret=SECRET, out=out)
+    try:
+        run_cluster_command(env, line)
+    finally:
+        env.close()
+    return out.getvalue()
+
+
+def test_fs_commands_end_to_end(stack, tmp_path):
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+
+    _, _, filer = stack
+    fc = FilerClient(filer.url)
+    try:
+        fc.put_data("/docs/a.txt", b"alpha")
+        fc.put_data("/docs/sub/b.txt", b"beta-beta")
+
+        ls = _shell(stack, "fs.ls /docs")
+        assert "a.txt" in ls and "sub/" in ls
+        lsl = _shell(stack, "fs.ls -l /docs")
+        assert "a.txt" in lsl and "5" in lsl
+
+        du = _shell(stack, "fs.du /docs")
+        assert "2 files" in du and "14 bytes" in du
+
+        cat = _shell(stack, "fs.cat /docs/a.txt")
+        assert "alpha" in cat
+
+        _shell(stack, "fs.mkdir /docs/newdir")
+        assert "newdir/" in _shell(stack, "fs.ls /docs")
+
+        _shell(stack, "fs.mv /docs/a.txt /docs/a2.txt")
+        ls2 = _shell(stack, "fs.ls /docs")
+        assert "a2.txt" in ls2 and "a.txt\n" not in ls2
+        assert fc.get_data("/docs/a2.txt") == b"alpha"
+
+        # meta save / load round-trip into a fresh subtree
+        meta = tmp_path / "meta.jsonl"
+        _shell(stack, f"fs.meta.save -o {meta} /docs")
+        lines = [json.loads(x) for x in
+                 meta.read_text().strip().splitlines()]
+        names = {e["name"] for e in lines}
+        assert {"a2.txt", "sub", "b.txt"} <= names
+        chunked = [e for e in lines if e["name"] == "a2.txt"][0]
+        assert chunked["chunks"], "meta.save must keep chunk manifests"
+
+        _shell(stack, "fs.rm -r /docs/sub")
+        with pytest.raises(Exception):
+            fc.get_data("/docs/sub/b.txt")
+        # restore the removed entries from the dump
+        _shell(stack, f"fs.meta.load -i {meta}")
+        assert fc.lookup("/docs/sub", "b.txt") is not None
+        # content readable again — chunks were preserved by meta.load
+        assert fc.get_data("/docs/sub/b.txt") == b"beta-beta"
+
+        rm_err = None
+        try:
+            _shell(stack, "fs.rm /docs/newdir2-missing")
+        except ShellError as e:
+            rm_err = str(e)
+        assert rm_err and "not found" in rm_err
+    finally:
+        fc.close()
+
+
+def test_fs_commands_require_filer(stack):
+    master, _, _ = stack
+    env = ClusterEnv(master_url=master.url, secret=SECRET,
+                     out=io.StringIO())
+    try:
+        with pytest.raises(ShellError, match="no filer configured"):
+            run_cluster_command(env, "fs.ls /")
+    finally:
+        env.close()
+
+
+def test_grpc_auth_rejects_unauthenticated(stack):
+    import grpc
+
+    _, vs, _ = stack
+    ch = grpc.insecure_channel(f"127.0.0.1:{vs.port + 10000}")
+    stub = pb.volume_stub(ch)
+    with pytest.raises(grpc.RpcError) as ei:
+        stub.VolumeStatus(volume_server_pb2.VolumeStatusRequest(
+            volume_id=1))
+    assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+    ch.close()
+
+
+def test_grpc_auth_rejects_wrong_key(stack):
+    import grpc
+
+    from seaweedfs_tpu.util import security
+
+    _, vs, _ = stack
+    ch = security.grpc_auth_channel(
+        grpc.insecure_channel(f"127.0.0.1:{vs.port + 10000}"),
+        security.Guard("not-the-key"))
+    stub = pb.volume_stub(ch)
+    with pytest.raises(grpc.RpcError) as ei:
+        stub.VolumeStatus(volume_server_pb2.VolumeStatusRequest(
+            volume_id=1))
+    assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+    ch.close()
+
+
+def test_grpc_auth_accepts_cluster_key_and_cluster_works(stack):
+    import grpc
+
+    from seaweedfs_tpu.util import security
+
+    master, vs, _ = stack
+    ch = security.grpc_auth_channel(
+        grpc.insecure_channel(f"127.0.0.1:{vs.port + 10000}"),
+        security.Guard(SECRET))
+    stub = pb.volume_stub(ch)
+    # any response (even an error payload) proves auth passed
+    resp = stub.VolumeStatus(volume_server_pb2.VolumeStatusRequest(
+        volume_id=12345))
+    assert resp is not None
+    ch.close()
+    # master-driven admin path (its stub carries the token): grow
+    vid = master.grow_volume()
+    assert vid >= 1
