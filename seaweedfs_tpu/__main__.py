@@ -80,6 +80,16 @@ def _run_filer_replicate(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_fix(argv: list[str]) -> int:
+    from .volume_tools import run_fix
+    return run_fix(argv)
+
+
+def _run_export(argv: list[str]) -> int:
+    from .volume_tools import run_export
+    return run_export(argv)
+
+
 def _run_webdav(argv: list[str]) -> int:
     from .gateway.webdav import main
     return main(argv)
@@ -98,6 +108,8 @@ COMMANDS = {
     "webdav": _run_webdav,
     "mount": _run_mount,
     "filer.replicate": _run_filer_replicate,
+    "fix": _run_fix,
+    "export": _run_export,
     "scaffold": _run_scaffold,
 }
 

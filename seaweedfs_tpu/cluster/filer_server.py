@@ -418,16 +418,36 @@ def main(argv: list[str]) -> int:
     p.add_argument("-replication", default="")
     p.add_argument("-db", default="",
                    help="sqlite metadata path (default: in-memory)")
+    p.add_argument("-notify.file", dest="notify_file", default="",
+                   help="append metadata events to this JSON-lines file")
+    p.add_argument("-notify.webhook", dest="notify_webhook", default="",
+                   help="POST metadata events to this URL")
     args = p.parse_args(argv)
     store = SqliteStore(args.db) if args.db else MemoryStore()
-    server = FilerServer(Filer(store), ip=args.ip, port=args.port,
+    filer = Filer(store)
+    server = FilerServer(filer, ip=args.ip, port=args.port,
                          master_url=args.master,
                          collection=args.collection,
                          replication=args.replication)
+    # Notifiers subscribe BEFORE the server opens its ports and stop
+    # AFTER it closes them, so no mutation at either lifecycle edge can
+    # slip past the bridge unobserved.
+    notifiers = []
+    if args.notify_file or args.notify_webhook:
+        from ..notification import (FilerNotifier, HttpWebhookQueue,
+                                    LogFileQueue)
+        if args.notify_file:
+            notifiers.append(FilerNotifier(
+                filer, LogFileQueue(args.notify_file)).start())
+        if args.notify_webhook:
+            notifiers.append(FilerNotifier(
+                filer, HttpWebhookQueue(args.notify_webhook)).start())
     server.start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     stop.wait()
     server.stop()
+    for n in notifiers:
+        n.stop()
     return 0

@@ -777,7 +777,22 @@ def _make_http_handler(vs: VolumeServer):
             try:
                 vid, fid, q = self._parse_fid()
                 data = vs.read_bytes(vid, fid, q.get("collection", ""))
-                self._send(200, data)
+                mime = ""
+                if "width" in q or "height" in q:
+                    try:
+                        w = int(q.get("width", 0) or 0)
+                        h = int(q.get("height", 0) or 0)
+                    except ValueError:
+                        self._json({"error": "width/height must be "
+                                    "integers"}, 400)
+                        vs.metrics.counter("read_requests",
+                                           code="400").inc()
+                        return
+                    # on-read image scaling (weed/images)
+                    from ..images import resized
+                    data, mime = resized(data, w, h, q.get("mode", ""))
+                self._send(200, data,
+                           mime or "application/octet-stream")
                 vs.metrics.counter("read_requests", code="200").inc()
             except (KeyError, StoreError) as e:
                 vs.metrics.counter("read_requests", code="404").inc()

@@ -44,6 +44,10 @@ class _Subscriber:
     queue: list = field(default_factory=list)
     cond: threading.Condition = field(
         default_factory=lambda: threading.Condition())
+    #: Set when _notify dropped events because this subscriber lagged
+    #: past MAX_SUB_QUEUE — the stream then errors instead of silently
+    #: skipping mutations.
+    overflowed: bool = False
 
 
 class Filer:
@@ -55,6 +59,10 @@ class Filer:
     #: log role): subscribers can catch up from ``since_ns`` as long as
     #: it is still inside the window.
     META_LOG_EVENTS = 10_000
+    #: Per-subscriber live-queue bound: a consumer stuck behind a slow
+    #: sink (e.g. a tar-pitted webhook) must not grow filer memory
+    #: without limit — past this, its events drop and its stream errors.
+    MAX_SUB_QUEUE = 10_000
 
     def __init__(self, store: Optional[FilerStore] = None):
         self.store = store or MemoryStore()
@@ -183,7 +191,10 @@ class Filer:
             subs = list(self._subs)
         for s in subs:
             with s.cond:
-                s.queue.append(ev)
+                if len(s.queue) >= self.MAX_SUB_QUEUE:
+                    s.overflowed = True
+                else:
+                    s.queue.append(ev)
                 s.cond.notify()
 
     def meta_log_covers(self, since_ns: int) -> bool:
@@ -223,6 +234,13 @@ class Filer:
             while stop is None or not stop.is_set():
                 with sub.cond:
                     while not sub.queue:
+                        if sub.overflowed:
+                            # drained up to the drop point: erroring
+                            # beats silently skipping mutations
+                            raise FilerError(
+                                "subscriber lagged past the queue "
+                                "bound; events dropped — full re-sync "
+                                "required")
                         if stop is not None and stop.is_set():
                             return
                         sub.cond.wait(timeout=0.1)

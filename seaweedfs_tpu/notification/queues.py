@@ -1,0 +1,125 @@
+"""Message queues + the filer->queue bridge (weed/notification)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from pathlib import Path
+from typing import Optional
+
+from ..util import glog
+
+
+def event_to_dict(ev) -> dict:
+    """Serialize a filer MetaEvent the way the reference publishes
+    EventNotification messages (old/new entry, chunks included)."""
+
+    def entry(e):
+        if e is None:
+            return None
+        return {
+            "path": e.path,
+            "isDir": e.attr.is_dir,
+            "size": e.size(),
+            "mtime": e.attr.mtime,
+            "chunks": [{"fileId": c.file_id, "offset": c.offset,
+                        "size": c.size} for c in e.chunks],
+        }
+
+    return {"tsNs": ev.ts_ns, "directory": ev.directory,
+            "oldEntry": entry(ev.old_entry),
+            "newEntry": entry(ev.new_entry)}
+
+
+class MessageQueue:
+    """One notification sink (notification.MessageQueue interface)."""
+
+    def send(self, event: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LogFileQueue(MessageQueue):
+    """Append-only JSON-lines event log."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def send(self, event: dict) -> None:
+        with self._lock:
+            self._f.write(json.dumps(event) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+class HttpWebhookQueue(MessageQueue):
+    """POST each event as JSON to a webhook URL. Delivery is
+    best-effort: a dead endpoint drops events (counted), it never
+    stalls the bridge."""
+
+    def __init__(self, url: str, timeout: float = 2.0):
+        self.url = url
+        self.timeout = timeout
+        self.sent = 0
+        self.dropped = 0
+
+    def send(self, event: dict) -> None:
+        body = json.dumps(event).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                self.sent += 1
+        except Exception as e:  # noqa: BLE001 — drop, don't stall
+            self.dropped += 1
+            if self.dropped in (1, 10, 100) or self.dropped % 1000 == 0:
+                glog.warning("notification webhook %s failing "
+                             "(%d dropped): %s", self.url,
+                             self.dropped, e)
+
+
+class FilerNotifier:
+    """Bridges one Filer's meta-log onto a MessageQueue on a dedicated
+    thread (filer_notify.go's notifyMetaListeners role for external
+    queues)."""
+
+    def __init__(self, filer, queue: MessageQueue,
+                 path_prefix: str = "/"):
+        self.filer = filer
+        self.queue = queue
+        self.path_prefix = "/" + path_prefix.strip("/")
+        self.published = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FilerNotifier":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="filer-notifier")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.queue.close()
+
+    def _run(self) -> None:
+        want = "/" if self.path_prefix == "/" else self.path_prefix + "/"
+        for ev in self.filer.subscribe(self._stop):
+            if not (ev.directory + "/").startswith(want):
+                continue
+            try:
+                self.queue.send(event_to_dict(ev))
+                self.published += 1
+            except Exception as e:  # noqa: BLE001 — keep the stream
+                glog.warning("notification publish failed: %s", e)

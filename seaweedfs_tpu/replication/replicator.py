@@ -93,12 +93,13 @@ class Replicator:
             except Exception as e:  # noqa: BLE001 — reconnect
                 if self._stop.is_set():
                     return
-                if "window expired" in str(e):
-                    # Source's meta-log no longer covers our resume
-                    # point: replay alone cannot converge — full
-                    # re-sync, even for noBootstrap replicators.
-                    glog.warning("replication: resume window expired; "
-                                 "re-syncing the tree")
+                if "re-sync required" in str(e):
+                    # Source says replay cannot converge (meta-log
+                    # window expired, or we lagged past the queue
+                    # bound) — full re-sync, even for noBootstrap
+                    # replicators.
+                    glog.warning("replication: %s; re-syncing the "
+                                 "tree", e)
                     need_bootstrap = True
                 glog.v(1, "replication stream broke: %s", e)
                 # the channel may be the casualty — dial fresh next time

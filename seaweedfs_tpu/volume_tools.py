@@ -1,0 +1,148 @@
+"""Offline volume tools: ``weed fix`` and ``weed export``.
+
+Mirrors weed/command/fix.go (rebuild a lost/corrupt .idx by walking the
+.dat's needle records) and weed/command/export.go (dump a volume's live
+needles to a tar archive, or list them). Both operate on files
+directly — no servers involved.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import time
+from pathlib import Path
+
+from .storage import needle as needle_mod
+from .storage.idx import CompactMap, IndexEntry
+from .storage.superblock import SuperBlock
+from .storage.types import NEEDLE_HEADER_SIZE, NEEDLE_PADDING_SIZE, \
+    to_offset_units
+from .storage.volume import dat_path, idx_path
+
+
+def walk_dat_records(base: str | Path):
+    """Yield (offset, body_size, Needle) for every decodable record in
+    a .dat, in file order, via incremental preads (volumes are
+    multi-GB; loading the whole file would OOM exactly when this
+    offline tool matters). Stops at the first undecodable position
+    (torn tail)."""
+    dp = dat_path(base)
+    total = dp.stat().st_size
+    if total < 8:
+        return
+    with open(dp, "rb") as f:
+        fd = f.fileno()
+        sb = SuperBlock.parse(os.pread(fd, 64, 0))
+        pos = sb.block_size
+        version = sb.version
+        while pos + NEEDLE_HEADER_SIZE <= total:
+            if pos % NEEDLE_PADDING_SIZE:
+                pos += (-pos) % NEEDLE_PADDING_SIZE
+                continue
+            try:
+                _, _nid, body = needle_mod.parse_header(
+                    os.pread(fd, NEEDLE_HEADER_SIZE, pos))
+                size = needle_mod.record_size(body, version)
+                if pos + size > total:
+                    return
+                n = needle_mod.Needle.parse(
+                    os.pread(fd, size, pos), version)
+            except needle_mod.NeedleError:
+                return
+            yield pos, body, n
+            pos += size
+
+
+def rebuild_idx(base: str | Path) -> int:
+    """fix.go: reconstruct <base>.idx from the .dat records. Later
+    records for the same id win (overwrite semantics); deletes cannot
+    be recovered (tombstones live only in the lost journal). Returns
+    the number of live entries written."""
+    entries: dict[int, IndexEntry] = {}
+    for pos, body_size, n in walk_dat_records(base):
+        entries[n.id] = IndexEntry(n.id, to_offset_units(pos),
+                                   body_size)
+    with open(idx_path(base), "wb") as f:
+        for key in sorted(entries):
+            f.write(entries[key].to_bytes())
+    return len(entries)
+
+
+def export_volume(base: str | Path, out_tar: str | Path) -> int:
+    """export.go: write every LIVE needle (per the .idx if present,
+    else the .dat walk) into a tar as ``<id>`` files. Streams one
+    record at a time — only the needle map, never the payloads, is
+    held in memory. Returns count."""
+    base = Path(base)
+    #: key -> (offset, body_size); payloads are read per-needle.
+    live: dict[int, tuple[int, int]] = {}
+    ip = idx_path(base)
+    if ip.exists():
+        nm = CompactMap.load_from_idx(ip)
+        for e in nm.live_entries():
+            live[e.key] = (e.byte_offset, e.size)
+    else:
+        for pos, body, n in walk_dat_records(base):
+            live[n.id] = (pos, body)
+    count = 0
+    with open(dat_path(base), "rb") as df, \
+            tarfile.open(out_tar, "w") as tf:
+        fd = df.fileno()
+        sb = SuperBlock.parse(os.pread(fd, 64, 0))
+        for key in sorted(live):
+            off, body = live[key]
+            size = needle_mod.record_size(body, sb.version)
+            n = needle_mod.Needle.parse(os.pread(fd, size, off),
+                                        sb.version)
+            name = n.name.decode("utf-8", "replace") if n.name \
+                else str(key)
+            info = tarfile.TarInfo(name=name)
+            info.size = len(n.data)
+            info.mtime = int(n.append_at_ns / 1e9) if n.append_at_ns \
+                else int(time.time())
+            tf.addfile(info, io.BytesIO(n.data))
+            count += 1
+    return count
+
+
+def run_fix(argv: list[str] | None = None) -> int:
+    """``weed fix -dir <d> -volumeId N [-collection c]``."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="fix")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    from .storage.store import volume_base_name
+    base = Path(args.dir) / volume_base_name(args.volumeId,
+                                             args.collection)
+    if not dat_path(base).exists():
+        print(f"fix: {dat_path(base)} not found")
+        return 1
+    n = rebuild_idx(base)
+    print(f"fix: rebuilt {idx_path(base)} with {n} entries")
+    return 0
+
+
+def run_export(argv: list[str] | None = None) -> int:
+    """``weed export -dir <d> -volumeId N -o out.tar``."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="export")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-o", dest="out", required=True)
+    args = p.parse_args(argv)
+    from .storage.store import volume_base_name
+    base = Path(args.dir) / volume_base_name(args.volumeId,
+                                             args.collection)
+    if not dat_path(base).exists():
+        print(f"export: {dat_path(base)} not found")
+        return 1
+    n = export_volume(base, args.out)
+    print(f"export: wrote {n} needles to {args.out}")
+    return 0

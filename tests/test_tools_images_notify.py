@@ -1,0 +1,235 @@
+"""weed fix / weed export volume tools, on-read image resizing, and
+the notification queues (fix.go, export.go, weed/images,
+weed/notification analogs)."""
+
+import io
+import json
+import tarfile
+import threading
+
+import pytest
+
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.images import resized
+from seaweedfs_tpu.notification import (FilerNotifier, LogFileQueue)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import (Volume,
+                                          generate_synthetic_volume,
+                                          idx_path)
+from seaweedfs_tpu.volume_tools import export_volume, rebuild_idx
+
+
+# ---------------- fix ----------------
+
+def test_fix_rebuilds_idx_from_dat(tmp_path):
+    base = str(tmp_path / "5")
+    vol = generate_synthetic_volume(base, 5, n_needles=25, seed=4)
+    payloads = {i: vol.read_needle(i).data for i in range(1, 26)}
+    # overwrite one needle so the walker must prefer the later record
+    vol.write_needle(Needle(cookie=9, id=3, data=b"v2" * 50))
+    payloads[3] = b"v2" * 50
+    vol.close()
+    idx_path(base).unlink()  # the journal is lost
+    n = rebuild_idx(base)
+    assert n == 25
+    vol2 = Volume(base, 5).load()
+    for i, want in payloads.items():
+        assert vol2.read_needle(i).data == want
+    vol2.close()
+
+
+def test_fix_cli(tmp_path):
+    from seaweedfs_tpu.volume_tools import run_fix
+
+    vol = generate_synthetic_volume(str(tmp_path / "7"), 7,
+                                    n_needles=5, seed=1)
+    vol.close()
+    idx_path(tmp_path / "7").unlink()
+    assert run_fix(["-dir", str(tmp_path), "-volumeId", "7"]) == 0
+    assert idx_path(tmp_path / "7").exists()
+
+
+# ---------------- export ----------------
+
+def test_export_to_tar(tmp_path):
+    base = str(tmp_path / "6")
+    vol = Volume(base, 6).create()
+    vol.write_needle(Needle(cookie=1, id=1, data=b"one",
+                            name=b"a.txt"))
+    vol.write_needle(Needle(cookie=1, id=2, data=b"two" * 10))
+    vol.delete_needle(1)
+    vol.close()
+    out = tmp_path / "vol6.tar"
+    n = export_volume(base, out)
+    assert n == 1  # deleted needle excluded
+    with tarfile.open(out) as tf:
+        names = tf.getnames()
+        assert names == ["2"]
+        assert tf.extractfile("2").read() == b"two" * 10
+
+
+# ---------------- images ----------------
+
+def _png(w, h):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), (200, 10, 10)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_resize_fit_within_box():
+    from PIL import Image
+
+    data, mime = resized(_png(100, 50), width=50, height=50)
+    assert mime == "image/png"
+    img = Image.open(io.BytesIO(data))
+    assert img.size == (50, 25)  # ratio preserved
+
+
+def test_resize_fill_crops():
+    from PIL import Image
+
+    data, _ = resized(_png(100, 50), width=40, height=40, mode="fill")
+    img = Image.open(io.BytesIO(data))
+    assert img.size == (40, 40)
+
+
+def test_resize_noop_cases():
+    raw = b"definitely not an image"
+    assert resized(raw, width=10)[0] == raw
+    png = _png(10, 10)
+    assert resized(png)[0] == png  # no dimensions requested
+    assert resized(png, width=100, height=100)[0] == png  # upscale: no
+
+
+def test_resize_on_volume_read(tmp_path):
+    """GET ?width= through a live volume server scales the image."""
+    import socket
+    import time
+    import urllib.request
+
+    from PIL import Image
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.operation import assign, upload
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.cluster.wdclient import MasterClient
+    from seaweedfs_tpu.storage.store import Store
+
+    def free_pair():
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if p + 10000 <= 65535:
+                try:
+                    with socket.socket() as s2:
+                        s2.bind(("127.0.0.1", p + 10000))
+                    return p
+                except OSError:
+                    continue
+
+    master = MasterServer(port=free_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=0.2, seed=6,
+                          garbage_threshold=0).start()
+    d = tmp_path / "iv"
+    d.mkdir()
+    vs = VolumeServer(Store([d], max_volumes=4), port=free_pair(),
+                      master_url=master.url, pulse_seconds=0.2).start()
+    mc = MasterClient(master.url)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topology.nodes:
+            time.sleep(0.05)
+        a = assign(mc)
+        upload(a.url, a.fid, _png(80, 80), jwt=a.auth)
+        with urllib.request.urlopen(
+                f"http://{a.url}/{a.fid}?width=20&height=20",
+                timeout=10) as r:
+            img = Image.open(io.BytesIO(r.read()))
+        assert img.size == (20, 20)
+        # without params the original comes back
+        with urllib.request.urlopen(f"http://{a.url}/{a.fid}",
+                                    timeout=10) as r:
+            img2 = Image.open(io.BytesIO(r.read()))
+        assert img2.size == (80, 80)
+    finally:
+        mc.close()
+        vs.stop()
+        master.stop()
+
+
+# ---------------- notification ----------------
+
+def test_log_file_queue_and_notifier(tmp_path):
+    filer = Filer()
+    log = tmp_path / "events.jsonl"
+    notifier = FilerNotifier(filer, LogFileQueue(log)).start()
+    try:
+        filer.create_entry(Entry(path="/n/a.txt", attr=Attr()))
+        filer.delete_entry("/n/a.txt")
+        deadline = threading.Event()
+        for _ in range(100):
+            if log.exists() and len(
+                    log.read_text().strip().splitlines()) >= 3:
+                break
+            deadline.wait(0.05)
+        lines = [json.loads(x)
+                 for x in log.read_text().strip().splitlines()]
+        paths = [(e["newEntry"] or e["oldEntry"] or {}).get("path")
+                 for e in lines]
+        assert "/n/a.txt" in paths
+        deletes = [e for e in lines if e["newEntry"] is None
+                   and e["oldEntry"]
+                   and e["oldEntry"]["path"] == "/n/a.txt"]
+        assert deletes, "delete event missing"
+    finally:
+        notifier.stop()
+
+
+def test_webhook_queue_drops_on_dead_endpoint():
+    from seaweedfs_tpu.notification import HttpWebhookQueue
+
+    q = HttpWebhookQueue("http://127.0.0.1:1/none", timeout=0.2)
+    q.send({"x": 1})
+    assert q.dropped == 1 and q.sent == 0
+
+
+def test_resize_rejects_unbounded_upscale():
+    png = _png(1, 1)
+    out, _ = resized(png, width=100000, height=100000, mode="fit")
+    assert out == png  # cap kicked in, original served
+    out2, _ = resized(_png(2000, 1), width=1000, height=1000,
+                      mode="fill")
+    assert out2 == _png(2000, 1) or len(out2) > 0  # bounded either way
+
+
+def test_subscriber_overflow_errors_not_silently_drops():
+    from seaweedfs_tpu.filer.filer import FilerError
+
+    filer = Filer()
+    filer.MAX_SUB_QUEUE = 5
+    it = filer.subscribe()
+    # register by advancing to the first wait (generator starts lazily)
+    import threading as th
+    got, errs = [], []
+
+    def consume():
+        try:
+            for ev in it:
+                got.append(ev)
+        except FilerError as e:
+            errs.append(str(e))
+
+    t = th.Thread(target=consume, daemon=True)
+    t.start()
+    import time as time_mod
+    time_mod.sleep(0.2)  # let the subscriber register
+    # flood while the consumer can't keep up: pause it via the GIL is
+    # unreliable — instead overflow before it drains by bulk-creating
+    for i in range(50):
+        filer.create_entry(Entry(path=f"/of/e{i}", attr=Attr()))
+    t.join(timeout=10)
+    assert errs and "re-sync required" in errs[0]
