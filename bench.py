@@ -467,6 +467,11 @@ def child_core() -> None:
 
     # -- end-to-end: synthetic .dat file -> 14 shard files (config 1) -----
     try:
+        # The file path writes ~1.4x its input to disk, so raw disk
+        # bandwidth is its ceiling — measure and report it so a slow
+        # container disk is not misread as codec slowness (PERF.md).
+        res["disk_write_gibps"] = round(_disk_write_gibps(), 3)
+        log(f"raw disk write: {res['disk_write_gibps']:.2f} GiB/s")
         e2e_file = _bench_end_to_end(on_acc)
         res["encode_e2e_file_gibps"] = round(e2e_file, 3)
         _persist(res)
@@ -545,6 +550,23 @@ def _smoke(enc, gf_apply, seg: int) -> None:
         raise AssertionError("device data-shard reconstruct mismatch")
     if not np.array_equal(got2[1], shards[11]):
         raise AssertionError("device parity-shard reconstruct mismatch")
+
+
+def _disk_write_gibps(n_bytes: int = 64 * MIB) -> float:
+    """Raw sequential write bandwidth of the temp filesystem."""
+    import tempfile
+
+    import numpy as np
+
+    buf = np.random.default_rng(1).integers(0, 256, n_bytes,
+                                            dtype=np.uint8)
+    with tempfile.NamedTemporaryFile() as f:
+        t0 = time.perf_counter()
+        buf.tofile(f)
+        f.flush()
+        os.fsync(f.fileno())
+        dt = time.perf_counter() - t0
+    return n_bytes / GIB / dt
 
 
 def _bench_end_to_end(on_acc: bool) -> float:

@@ -782,9 +782,11 @@ def _make_http_handler(vs: VolumeServer):
                     try:
                         w = int(q.get("width", 0) or 0)
                         h = int(q.get("height", 0) or 0)
+                        if w < 0 or h < 0:
+                            raise ValueError
                     except ValueError:
                         self._json({"error": "width/height must be "
-                                    "integers"}, 400)
+                                    "non-negative integers"}, 400)
                         vs.metrics.counter("read_requests",
                                            code="400").inc()
                         return

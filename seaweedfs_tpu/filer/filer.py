@@ -209,14 +209,20 @@ class Filer:
             return self._meta_log[0].ts_ns <= since_ns
 
     def subscribe(self, stop: Optional[threading.Event] = None,
-                  since_ns: int = 0) -> Iterator[MetaEvent]:
+                  since_ns: int = 0,
+                  registered: Optional[threading.Event] = None
+                  ) -> Iterator[MetaEvent]:
         """Blocking event stream (SubscribeMetadata). Iterate on a
         dedicated thread; set ``stop`` to end the stream.
 
         ``since_ns > 0`` first replays logged events newer than that
         timestamp (up to the META_LOG_EVENTS window), then streams live.
         Registration and the replay snapshot happen under one lock, so
-        no event is lost or duplicated across the seam."""
+        no event is lost or duplicated across the seam. ``registered``
+        (if given) is set the moment the subscriber is attached — a
+        caller that must not miss events (the notifier bridge, before
+        its server opens ports) waits on it, because a generator body
+        only runs at the first next()."""
         sub = _Subscriber()
         with self._lock:
             if since_ns and not self.meta_log_covers(since_ns):
@@ -226,6 +232,8 @@ class Filer:
             replay = [ev for ev in self._meta_log
                       if ev.ts_ns > since_ns] if since_ns else []
             self._subs.append(sub)
+        if registered is not None:
+            registered.set()
         try:
             for ev in replay:
                 if stop is not None and stop.is_set():

@@ -33,19 +33,25 @@ def resized(data: bytes, width: int = 0, height: int = 0,
         return data, ""
     fmt = (img.format or "PNG").upper()
     w, h = img.size
-    tw, th = width or w, height or h
-    if tw * th > MAX_PIXELS:
+    if width < 0 or height < 0:
         return data, _FORMATS.get(fmt, "")
+    tw, th = width or w, height or h
     if w <= tw and h <= th and mode != "fit":
         return data, _FORMATS.get(fmt, "")
+    # The pixel cap is evaluated on what would actually be ALLOCATED
+    # per mode (output, plus fill's cover intermediate) — capping
+    # tw*th up front would wrongly reject a small single-axis
+    # downscale of a large image (th defaults to the original height).
     if mode == "fit":
         # exact target box (resizing.go's "fit": may change the ratio)
+        if tw * th > MAX_PIXELS:
+            return data, _FORMATS.get(fmt, "")
         out = img.resize((tw, th))
     elif mode == "fill":
         # cover the box, then center-crop to it
         scale = max(tw / w, th / h)
         iw, ih = max(1, round(w * scale)), max(1, round(h * scale))
-        if iw * ih > MAX_PIXELS:
+        if iw * ih > MAX_PIXELS or tw * th > MAX_PIXELS:
             return data, _FORMATS.get(fmt, "")
         out = img.resize((iw, ih))
         left = (out.width - tw) // 2
@@ -54,8 +60,10 @@ def resized(data: bytes, width: int = 0, height: int = 0,
     else:
         # default: fit WITHIN the box, preserving the ratio
         scale = min(tw / w, th / h, 1.0)
-        out = img.resize((max(1, round(w * scale)),
-                          max(1, round(h * scale))))
+        ow, oh = max(1, round(w * scale)), max(1, round(h * scale))
+        if ow * oh > MAX_PIXELS:
+            return data, _FORMATS.get(fmt, "")
+        out = img.resize((ow, oh))
     buf = io.BytesIO()
     save_fmt = fmt if fmt in _FORMATS else "PNG"
     if save_fmt == "JPEG" and out.mode not in ("RGB", "L"):

@@ -98,13 +98,21 @@ class FilerNotifier:
         self.queue = queue
         self.path_prefix = "/" + path_prefix.strip("/")
         self.published = 0
+        #: Events lost to subscriber-queue overflow (slow sink) — the
+        #: bridge re-subscribes and keeps going rather than dying.
+        self.lost = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "FilerNotifier":
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="filer-notifier")
+        registered = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(registered,), daemon=True,
+            name="filer-notifier")
         self._thread.start()
+        # Block until the subscriber is attached so no mutation between
+        # start() and the thread's first iteration can slip past.
+        registered.wait(timeout=5)
         return self
 
     def stop(self) -> None:
@@ -113,13 +121,24 @@ class FilerNotifier:
             self._thread.join(timeout=5)
         self.queue.close()
 
-    def _run(self) -> None:
+    def _run(self, registered: Optional[threading.Event] = None) -> None:
         want = "/" if self.path_prefix == "/" else self.path_prefix + "/"
-        for ev in self.filer.subscribe(self._stop):
-            if not (ev.directory + "/").startswith(want):
-                continue
+        while not self._stop.is_set():
             try:
-                self.queue.send(event_to_dict(ev))
-                self.published += 1
-            except Exception as e:  # noqa: BLE001 — keep the stream
-                glog.warning("notification publish failed: %s", e)
+                for ev in self.filer.subscribe(self._stop,
+                                               registered=registered):
+                    if not (ev.directory + "/").startswith(want):
+                        continue
+                    try:
+                        self.queue.send(event_to_dict(ev))
+                        self.published += 1
+                    except Exception as e:  # noqa: BLE001 — keep going
+                        glog.warning("notification publish failed: %s",
+                                     e)
+                return  # stop was set
+            except Exception as e:  # noqa: BLE001 — lagged: re-attach
+                self.lost += 1
+                glog.warning("notification stream broke (%s); "
+                             "re-subscribing", e)
+                registered = None
+                self._stop.wait(0.2)

@@ -70,6 +70,22 @@ def rebuild_idx(base: str | Path) -> int:
     return len(entries)
 
 
+def _safe_tar_name(raw: bytes, key: int, used: set[str]) -> str:
+    """Archive member name from a client-controlled needle name:
+    traversal components and absolute paths are stripped (an extracted
+    archive must never write outside its directory), and collisions
+    get the needle id appended (silent last-wins extraction would lose
+    exported data)."""
+    name = raw.decode("utf-8", "replace") if raw else ""
+    parts = [p for p in name.split("/")
+             if p not in ("", ".", "..")]
+    name = "/".join(parts) or str(key)
+    if name in used:
+        name = f"{name}.{key}"
+    used.add(name)
+    return name
+
+
 def export_volume(base: str | Path, out_tar: str | Path) -> int:
     """export.go: write every LIVE needle (per the .idx if present,
     else the .dat walk) into a tar as ``<id>`` files. Streams one
@@ -87,6 +103,7 @@ def export_volume(base: str | Path, out_tar: str | Path) -> int:
         for pos, body, n in walk_dat_records(base):
             live[n.id] = (pos, body)
     count = 0
+    used_names: set[str] = set()
     with open(dat_path(base), "rb") as df, \
             tarfile.open(out_tar, "w") as tf:
         fd = df.fileno()
@@ -96,8 +113,7 @@ def export_volume(base: str | Path, out_tar: str | Path) -> int:
             size = needle_mod.record_size(body, sb.version)
             n = needle_mod.Needle.parse(os.pread(fd, size, off),
                                         sb.version)
-            name = n.name.decode("utf-8", "replace") if n.name \
-                else str(key)
+            name = _safe_tar_name(n.name, key, used_names)
             info = tarfile.TarInfo(name=name)
             info.size = len(n.data)
             info.mtime = int(n.append_at_ns / 1e9) if n.append_at_ns \

@@ -198,38 +198,108 @@ def test_webhook_queue_drops_on_dead_endpoint():
 
 
 def test_resize_rejects_unbounded_upscale():
+    from PIL import Image
+
     png = _png(1, 1)
     out, _ = resized(png, width=100000, height=100000, mode="fit")
     assert out == png  # cap kicked in, original served
-    out2, _ = resized(_png(2000, 1), width=1000, height=1000,
-                      mode="fill")
-    assert out2 == _png(2000, 1) or len(out2) > 0  # bounded either way
+    # fill whose COVER intermediate would blow the cap: original back
+    wide = _png(4000, 1)
+    out2, _ = resized(wide, width=2000, height=2000, mode="fill")
+    assert out2 == wide
+    # single-axis downscale of a large image stays allowed (the cap
+    # must apply to the OUTPUT, not width x original-height)
+    tall = _png(200, 2000)
+    out3, _ = resized(tall, width=100)
+    img = Image.open(io.BytesIO(out3))
+    assert img.size == (100, 1000)
+    # negative dimensions: original served unchanged
+    assert resized(png, width=-5, height=20, mode="fit")[0] == png
 
 
 def test_subscriber_overflow_errors_not_silently_drops():
+    import threading as th
+
     from seaweedfs_tpu.filer.filer import FilerError
 
     filer = Filer()
     filer.MAX_SUB_QUEUE = 5
-    it = filer.subscribe()
-    # register by advancing to the first wait (generator starts lazily)
-    import threading as th
+    registered = th.Event()
+    gate = th.Event()  # parks the consumer after its first event
     got, errs = [], []
 
     def consume():
         try:
-            for ev in it:
+            for ev in filer.subscribe(registered=registered):
                 got.append(ev)
+                gate.wait(timeout=10)
         except FilerError as e:
             errs.append(str(e))
 
     t = th.Thread(target=consume, daemon=True)
     t.start()
-    import time as time_mod
-    time_mod.sleep(0.2)  # let the subscriber register
-    # flood while the consumer can't keep up: pause it via the GIL is
-    # unreliable — instead overflow before it drains by bulk-creating
-    for i in range(50):
+    assert registered.wait(timeout=5)
+    # Deterministic overflow: the consumer takes one event then parks
+    # on the gate, so the flood provably exceeds MAX_SUB_QUEUE.
+    for i in range(10):
         filer.create_entry(Entry(path=f"/of/e{i}", attr=Attr()))
+    gate.set()
     t.join(timeout=10)
     assert errs and "re-sync required" in errs[0]
+    # the events queued before the drop point were still delivered
+    assert 1 <= len(got) <= 6
+
+
+def test_export_sanitizes_tar_names(tmp_path):
+    base = str(tmp_path / "8")
+    vol = Volume(base, 8).create()
+    vol.write_needle(Needle(cookie=1, id=1, data=b"x",
+                            name=b"../../etc/passwd"))
+    vol.write_needle(Needle(cookie=1, id=2, data=b"y", name=b"dup"))
+    vol.write_needle(Needle(cookie=1, id=3, data=b"z", name=b"dup"))
+    vol.close()
+    out = tmp_path / "v8.tar"
+    assert export_volume(base, out) == 3
+    with tarfile.open(out) as tf:
+        names = sorted(tf.getnames())
+        assert all(not n.startswith(("/", "..")) and ".." not in
+                   n.split("/") for n in names)
+        assert "etc/passwd" in names
+        assert "dup" in names and "dup.3" in names
+        assert tf.extractfile("dup").read() == b"y"
+        assert tf.extractfile("dup.3").read() == b"z"
+
+
+def test_notifier_survives_subscriber_overflow(tmp_path):
+    """The external bridge must re-subscribe after lagging, not die."""
+    import time as time_mod
+
+    filer = Filer()
+    filer.MAX_SUB_QUEUE = 3
+    log = tmp_path / "ev.jsonl"
+
+    class SlowQueue(LogFileQueue):
+        def send(self, event):
+            time_mod.sleep(0.05)
+            super().send(event)
+
+    notifier = FilerNotifier(filer, SlowQueue(log)).start()
+    try:
+        for i in range(30):  # overflow the 3-slot queue repeatedly
+            filer.create_entry(Entry(path=f"/nv/e{i}", attr=Attr()))
+        deadline = time_mod.time() + 15
+        while time_mod.time() < deadline and notifier.lost == 0:
+            time_mod.sleep(0.05)
+        assert notifier.lost >= 1
+        # still alive: a new event (post-resubscribe) gets published
+        before = notifier.published
+        deadline = time_mod.time() + 15
+        while time_mod.time() < deadline:
+            filer.create_entry(Entry(path=f"/nv/late{time_mod.time_ns()}",
+                                     attr=Attr()))
+            if notifier.published > before:
+                break
+            time_mod.sleep(0.2)
+        assert notifier.published > before
+    finally:
+        notifier.stop()
