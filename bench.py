@@ -320,15 +320,30 @@ def child_core() -> None:
     res: dict = {}
     dev = jax.devices()[0]
     on_acc = _on_accelerator()
-    log(f"device: {dev} platform={dev.platform} accelerator={on_acc}")
+    # Validation hook: BENCH_PALLAS_INTERPRET=1 drives the EXACT code
+    # path the TPU run takes (Pallas kernel, slab loop, checksum timer)
+    # through the Pallas interpreter on CPU at tiny shapes — so a shape
+    # or tracing bug is caught without the (intermittent) chip.
+    interp = os.environ.get("BENCH_PALLAS_INTERPRET") == "1"
+    if interp:
+        # validation numbers must never pollute the real round partials
+        global PARTIAL
+        PARTIAL = os.path.join(ARTIFACTS, "BENCH_partial_interp.jsonl")
+    log(f"device: {dev} platform={dev.platform} accelerator={on_acc}"
+        + (" [pallas-interpret validation]" if interp else ""))
 
     k, m = 10, 4
     enc = Encoder(k, m)
     coefs = enc.parity_coefs
     seg = rs_pallas.SEG_BYTES
 
-    gf_apply = rs_pallas.apply_gf_matrix if on_acc else \
-        bitslice.apply_gf_matrix
+    if interp:
+        def gf_apply(c, x):
+            return rs_pallas.apply_gf_matrix(c, x, interpret=True)
+        on_acc = True
+    else:
+        gf_apply = rs_pallas.apply_gf_matrix if on_acc else \
+            bitslice.apply_gf_matrix
 
     def make_encode(s):
         del s
@@ -344,9 +359,11 @@ def child_core() -> None:
 
     # -- headline: ~1 GiB streamed through (1, 10, slab) device calls -----
     s = (SLAB_S0 // 2 if shrink else SLAB_S0) // seg * seg
-    if not on_acc:
+    if interp:
+        s = seg  # interpreter is slow; one segment exercises the path
+    elif not on_acc:
         s = 2 * MIB  # CPU smoke scale; headline comes from native below
-    n_bufs = max(2, min(7, -(-GIB // (k * s))))
+    n_bufs = 2 if interp else max(2, min(7, -(-GIB // (k * s))))
     host_slabs = _make_slabs(n_bufs, k, s)
     encode_fn, dev_slabs, s, host_slabs = _compile_or_shrink(
         make_encode, host_slabs, k, s)
@@ -446,8 +463,9 @@ def child_core() -> None:
             acoefs = aenc.parity_coefs
             alt_fn = jax.jit(lambda v, _c=acoefs: gf_apply(_c, v))
             # Keep per-call input within the k=10 slab's verified
-            # compile envelope (k*s bytes), whatever ak is.
-            a_s = min(s, (k * s // ak) // seg * seg)
+            # compile envelope (k*s bytes), whatever ak is — but never
+            # below one segment (ak > k at tiny s would hit zero).
+            a_s = max(seg, min(s, (k * s // ak) // seg * seg))
             a_host = _make_slabs(2, ak, a_s, seed=ak)
             a_dev = [jax.device_put(h) for h in a_host]
             timer.start()
@@ -472,7 +490,7 @@ def child_core() -> None:
         # container disk is not misread as codec slowness (PERF.md).
         res["disk_write_gibps"] = round(_disk_write_gibps(), 3)
         log(f"raw disk write: {res['disk_write_gibps']:.2f} GiB/s")
-        e2e_file = _bench_end_to_end(on_acc)
+        e2e_file = _bench_end_to_end(on_acc and not interp)
         res["encode_e2e_file_gibps"] = round(e2e_file, 3)
         _persist(res)
     except Exception as e:  # noqa: BLE001 — sub-benches never kill the run

@@ -85,6 +85,16 @@ def _run_fix(argv: list[str]) -> int:
     return run_fix(argv)
 
 
+def _run_server(argv: list[str]) -> int:
+    from .server_cmd import main
+    return main(argv)
+
+
+def _run_compact(argv: list[str]) -> int:
+    from .server_cmd import run_compact
+    return run_compact(argv)
+
+
 def _run_export(argv: list[str]) -> int:
     from .volume_tools import run_export
     return run_export(argv)
@@ -110,6 +120,8 @@ COMMANDS = {
     "filer.replicate": _run_filer_replicate,
     "fix": _run_fix,
     "export": _run_export,
+    "server": _run_server,
+    "compact": _run_compact,
     "scaffold": _run_scaffold,
 }
 

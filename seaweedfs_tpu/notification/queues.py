@@ -98,8 +98,11 @@ class FilerNotifier:
         self.queue = queue
         self.path_prefix = "/" + path_prefix.strip("/")
         self.published = 0
-        #: Events lost to subscriber-queue overflow (slow sink) — the
-        #: bridge re-subscribes and keeps going rather than dying.
+        #: Times the bridge lagged and had to re-attach (usually fully
+        #: recovered via meta-log replay).
+        self.resubscribed = 0
+        #: Events UNRECOVERABLY lost: the lag outran the meta-log
+        #: replay window too.
         self.lost = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -112,7 +115,9 @@ class FilerNotifier:
         self._thread.start()
         # Block until the subscriber is attached so no mutation between
         # start() and the thread's first iteration can slip past.
-        registered.wait(timeout=5)
+        if not registered.wait(timeout=5):
+            glog.warning("filer notifier did not attach within 5s; "
+                         "early events may be missed")
         return self
 
     def stop(self) -> None:
@@ -123,10 +128,14 @@ class FilerNotifier:
 
     def _run(self, registered: Optional[threading.Event] = None) -> None:
         want = "/" if self.path_prefix == "/" else self.path_prefix + "/"
+        last_ts = 0
+        since = 0
         while not self._stop.is_set():
             try:
                 for ev in self.filer.subscribe(self._stop,
+                                               since_ns=since,
                                                registered=registered):
+                    last_ts = ev.ts_ns
                     if not (ev.directory + "/").startswith(want):
                         continue
                     try:
@@ -137,8 +146,17 @@ class FilerNotifier:
                                      e)
                 return  # stop was set
             except Exception as e:  # noqa: BLE001 — lagged: re-attach
-                self.lost += 1
-                glog.warning("notification stream broke (%s); "
-                             "re-subscribing", e)
                 registered = None
+                self.resubscribed += 1
+                if "window expired" in str(e) or not last_ts:
+                    # beyond the replay window: genuinely lost ground
+                    self.lost += 1
+                    since = 0
+                    glog.warning("notification stream lost events "
+                                 "(%s); re-subscribing live", e)
+                else:
+                    # recover the dropped span from the meta-log replay
+                    since = max(1, last_ts - 1)
+                    glog.v(1, "notification stream lagged (%s); "
+                           "replaying from %d", e, since)
                 self._stop.wait(0.2)

@@ -80,10 +80,14 @@ def _safe_tar_name(raw: bytes, key: int, used: set[str]) -> str:
     parts = [p for p in name.split("/")
              if p not in ("", ".", "..")]
     name = "/".join(parts) or str(key)
-    if name in used:
-        name = f"{name}.{key}"
-    used.add(name)
-    return name
+    # suffix until actually unique — one fixed suffix could itself
+    # collide with a stored name like "dup.<key>"
+    candidate, n = name, 0
+    while candidate in used:
+        candidate = f"{name}.{key}" if n == 0 else f"{name}.{key}.{n}"
+        n += 1
+    used.add(candidate)
+    return candidate
 
 
 def export_volume(base: str | Path, out_tar: str | Path) -> int:
