@@ -31,6 +31,16 @@ class FilerError(RuntimeError):
     pass
 
 
+class FilerResyncRequired(FilerError):
+    """Replay cannot converge (meta-log window expired, or a subscriber
+    lagged past its queue bound): the consumer must do a full re-sync.
+
+    In-process consumers catch this type; cross-process consumers (gRPC
+    stream clients) only see the message text, so it MUST contain the
+    stable marker ``re-sync required`` — that substring is the wire
+    contract the replicator matches on."""
+
+
 @dataclass
 class MetaEvent:
     ts_ns: int
@@ -226,7 +236,7 @@ class Filer:
         sub = _Subscriber()
         with self._lock:
             if since_ns and not self.meta_log_covers(since_ns):
-                raise FilerError(
+                raise FilerResyncRequired(
                     f"meta log window expired for since_ns={since_ns}; "
                     "full re-sync required")
             replay = [ev for ev in self._meta_log
@@ -245,7 +255,7 @@ class Filer:
                         if sub.overflowed:
                             # drained up to the drop point: erroring
                             # beats silently skipping mutations
-                            raise FilerError(
+                            raise FilerResyncRequired(
                                 "subscriber lagged past the queue "
                                 "bound; events dropped — full re-sync "
                                 "required")

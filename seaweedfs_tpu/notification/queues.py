@@ -146,9 +146,13 @@ class FilerNotifier:
                                      e)
                 return  # stop was set
             except Exception as e:  # noqa: BLE001 — lagged: re-attach
+                from ..filer.filer import FilerResyncRequired
+
                 registered = None
                 self.resubscribed += 1
-                if "window expired" in str(e) or not last_ts:
+                window_gone = (isinstance(e, FilerResyncRequired)
+                               and "window expired" in str(e))
+                if window_gone or not last_ts:
                     # beyond the replay window: genuinely lost ground
                     self.lost += 1
                     since = 0

@@ -8,6 +8,6 @@ file CONTENT, so the destination owns fresh chunks in its own cluster.
 """
 
 from .replicator import Replicator
-from .sinks import FilerSink, ReplicationSink
+from .sinks import FilerSink, ReplicationSink, S3Sink
 
-__all__ = ["FilerSink", "ReplicationSink", "Replicator"]
+__all__ = ["FilerSink", "ReplicationSink", "Replicator", "S3Sink"]

@@ -16,6 +16,26 @@ from ..cluster.filer_client import FilerClient, FilerClientError
 from ..util import glog
 
 
+def _as_filer_client(c: "FilerClient | str") -> FilerClient:
+    return c if isinstance(c, FilerClient) else FilerClient(c)
+
+
+def _entry_size(entry) -> int:
+    return max(entry.attributes.file_size,
+               max((c.offset + c.size for c in entry.chunks),
+                   default=0))
+
+
+def _src_signature(entry) -> bytes:
+    """Identity of the SOURCE entry's content: its chunk manifest.
+    Chunk fids change on every source write (appends mint new fids), so
+    this distinguishes same-size same-second overwrites that an
+    (mtime, size) check cannot."""
+    sig = ";".join(f"{c.file_id}@{c.offset}+{c.size}"
+                   for c in entry.chunks)
+    return sig.encode()
+
+
 class ReplicationSink:
     """One replication target. ``apply`` receives the source path and
     the entry's new state (None = deleted)."""
@@ -31,26 +51,14 @@ class FilerSink(ReplicationSink):
     def __init__(self, source: FilerClient | str,
                  destination: FilerClient | str,
                  dst_prefix: str = "/"):
-        self.src = source if isinstance(source, FilerClient) \
-            else FilerClient(source)
-        self.dst = destination if isinstance(destination, FilerClient) \
-            else FilerClient(destination)
+        self.src = _as_filer_client(source)
+        self.dst = _as_filer_client(destination)
         self.dst_prefix = "/" + dst_prefix.strip("/")
 
     def _dst_path(self, path: str) -> str:
         if self.dst_prefix == "/":
             return path
         return self.dst_prefix + path
-
-    @staticmethod
-    def _src_signature(entry) -> bytes:
-        """Identity of the SOURCE entry's content: its chunk manifest.
-        Chunk fids change on every source write (appends mint new fids),
-        so this distinguishes same-size same-second overwrites that an
-        (mtime, size) check cannot."""
-        sig = ";".join(f"{c.file_id}@{c.offset}+{c.size}"
-                       for c in entry.chunks)
-        return sig.encode()
 
     def apply(self, path: str, new_entry, old_entry=None) -> None:
         dst_path = self._dst_path(path)
@@ -74,13 +82,11 @@ class FilerSink(ReplicationSink):
                     dup.extended[k] = v
                 self.dst.create(d or "/", dup)
             return
-        size = max(new_entry.attributes.file_size,
-                   max((c.offset + c.size for c in new_entry.chunks),
-                       default=0))
+        size = _entry_size(new_entry)
         # Idempotence: the destination entry remembers which source
         # chunk manifest it was copied from; matching signature = same
         # content, skip (bootstrap + replay overlap is then free).
-        sig = self._src_signature(new_entry)
+        sig = _src_signature(new_entry)
         existing = self.dst.lookup(d or "/", n)
         if existing is not None and not existing.is_directory and \
                 existing.extended.get("replication.src_sig") == sig:
@@ -101,3 +107,84 @@ class FilerSink(ReplicationSink):
     def close(self) -> None:
         self.src.close()
         self.dst.close()
+
+
+class S3Sink(ReplicationSink):
+    """Replicate filer mutations into an S3 bucket (the reference's
+    weed/replication/sink/s3sink role): files become objects keyed by
+    their filer path (under ``key_prefix``), deletes remove the object.
+    Works against any SigV4 endpoint — including this project's own S3
+    gateway. Directories have no S3 analog and are skipped (prefixes
+    materialize through object keys)."""
+
+    def __init__(self, source: FilerClient | str, endpoint: str,
+                 bucket: str, access_key: str = "",
+                 secret_key: str = "", key_prefix: str = "",
+                 region: str = "us-east-1"):
+        self.src = _as_filer_client(source)
+        # honor an explicit scheme; bare host:port defaults to http
+        # (the in-cluster gateway case)
+        ep = endpoint.rstrip("/")
+        if "://" not in ep:
+            ep = "http://" + ep
+        self.endpoint = ep
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.key_prefix = key_prefix.strip("/")
+        self.region = region
+        #: path -> last pushed source signature. Absorbs the
+        #: replicator's deliberate overlap (bootstrap skew, window
+        #: re-sync) within this process — S3 has no cheap server-side
+        #: equivalent of the FilerSink's extended-attribute check.
+        self._pushed: dict[str, bytes] = {}
+
+    def _url(self, path: str) -> str:
+        key = path.lstrip("/")
+        if self.key_prefix:
+            key = f"{self.key_prefix}/{key}"
+        import urllib.parse as up
+        return f"{self.endpoint}/{self.bucket}/" + up.quote(key)
+
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 mime: str = "") -> None:
+        import urllib.error
+        import urllib.request
+
+        url = self._url(path)
+        headers = {"Content-Type": mime} if mime else {}
+        if self.access_key:
+            from ..gateway.s3_auth import sign_request_headers
+            headers = sign_request_headers(method, url, headers, body,
+                                           self.access_key,
+                                           self.secret_key,
+                                           region=self.region)
+        req = urllib.request.Request(
+            url, data=body if method == "PUT" else None,
+            method=method, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60):
+                pass
+        except urllib.error.HTTPError as e:
+            if method == "DELETE" and e.code == 404:
+                return
+            raise FilerClientError(
+                f"s3 {method} {url}: {e.code}") from e
+
+    def apply(self, path: str, new_entry, old_entry=None) -> None:
+        if new_entry is None:
+            self._pushed.pop(path, None)
+            self._request("DELETE", path)
+            return
+        if new_entry.is_directory:
+            return  # prefixes materialize through object keys
+        sig = _src_signature(new_entry)
+        if self._pushed.get(path) == sig:
+            return  # replay/bootstrap overlap: already pushed
+        data = self.src.get_data(path) if _entry_size(new_entry) else b""
+        self._request("PUT", path, data,
+                      mime=new_entry.attributes.mime)
+        self._pushed[path] = sig
+
+    def close(self) -> None:
+        self.src.close()
