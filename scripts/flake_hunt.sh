@@ -1,0 +1,8 @@
+#!/bin/bash
+# Run the suite repeatedly; log any failure names with timestamps.
+cd /root/repo || exit 1
+for i in $(seq 1 8); do
+  out=$(timeout 500 python -m pytest tests/ -q 2>&1 | grep -E "FAILED|passed|failed" | tail -3)
+  echo "$(date +%s) run$i: $out" >> artifacts/flake_hunt.log
+done
+echo "$(date +%s) done" >> artifacts/flake_hunt.log

@@ -55,7 +55,9 @@ class MasterServer:
                  garbage_scan_seconds: float = 60.0,
                  peers: Optional[list[str]] = None,
                  meta_dir: Optional[str] = None,
-                 election_timeout: tuple[float, float] = (0.45, 0.9)):
+                 election_timeout: tuple[float, float] = (0.45, 0.9),
+                 metrics_address: str = "",
+                 metrics_interval_seconds: float = 15.0):
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
@@ -84,6 +86,12 @@ class MasterServer:
         self.garbage_scan_seconds = garbage_scan_seconds
         self.guard = security.Guard(secret)
         self.metrics = Metrics(namespace="master")
+        #: Prometheus push-gateway address, distributed to volume
+        #: servers via heartbeat responses (the reference's
+        #: -metrics.address flow).
+        self.metrics_address = metrics_address
+        self.metrics_interval_seconds = metrics_interval_seconds
+        self._pusher = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
         self._http_server: Optional[ThreadingHTTPServer] = None
@@ -146,6 +154,11 @@ class MasterServer:
                                         name=f"master-reaper-{self.port}")
         self._reaper.start()
         self.ha.start()
+        if self.metrics_address:
+            from ..util.stats import MetricsPusher
+            self._pusher = MetricsPusher(
+                self.metrics, self.metrics_address, "master", self.url,
+                self.metrics_interval_seconds).start()
         glog.info("master started at %s (grpc %d)", self.url,
                   _grpc_port(self.port))
         return self
@@ -153,6 +166,8 @@ class MasterServer:
     def stop(self) -> None:
         self._stop.set()
         self.ha.stop()
+        if self._pusher is not None:
+            self._pusher.stop()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         if self._http_server:
@@ -420,7 +435,8 @@ class _MasterServicer:
                 ms.sequencer.set_max(hb.max_file_key)
             yield master_pb2.HeartbeatResponse(
                 volume_size_limit=ms.topology.volume_size_limit,
-                leader=ms.leader_url or ms.url)
+                leader=ms.leader_url or ms.url,
+                metrics_address=ms.metrics_address)
 
     def Assign(self, request, context):
         try:
@@ -512,7 +528,11 @@ class _MasterServicer:
     def GetMasterConfiguration(self, request, context):
         return master_pb2.GetMasterConfigurationResponse(
             volume_size_limit=self.ms.topology.volume_size_limit,
-            jwt_enabled=self.ms.guard.enabled)
+            jwt_enabled=self.ms.guard.enabled,
+            metrics_address=self.ms.metrics_address,
+            metrics_interval_seconds=max(1, round(
+                self.ms.metrics_interval_seconds))
+            if self.ms.metrics_address else 0)
 
 
 def _make_http_handler(ms: MasterServer):
@@ -647,6 +667,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-metricsAddress", default="",
+                   help="Prometheus push-gateway host:port")
+    p.add_argument("-metricsIntervalSeconds", type=float, default=15.0)
     p.add_argument("-peers", default="",
                    help="comma-separated master urls for HA election")
     p.add_argument("-mdir", default="",
@@ -660,7 +683,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                       default_replication=args.defaultReplication,
                       pulse_seconds=args.pulseSeconds, secret=secret,
                       peers=[x for x in args.peers.split(",") if x],
-                      meta_dir=args.mdir or None)
+                      meta_dir=args.mdir or None,
+                      metrics_address=args.metricsAddress,
+                      metrics_interval_seconds=args.metricsIntervalSeconds)
     ms.start()
     try:
         while True:

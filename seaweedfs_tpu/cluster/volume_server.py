@@ -110,6 +110,7 @@ class VolumeServer:
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._metrics_pusher = None
         self._lock = threading.RLock()
 
     # ------------- lifecycle -------------
@@ -159,6 +160,10 @@ class VolumeServer:
         for ch in self._channels.values():
             ch.close()
         self._channels.clear()
+        with self._lock:
+            if self._metrics_pusher is not None:
+                self._metrics_pusher.stop()
+                self._metrics_pusher = None
         self.store.close()
 
     def __enter__(self) -> "VolumeServer":
@@ -272,6 +277,7 @@ class VolumeServer:
         for resp in stub.SendHeartbeat(gen()):
             if resp.volume_size_limit:
                 self.volume_size_limit = resp.volume_size_limit
+            self._set_metrics_pusher(resp.metrics_address)
             if resp.leader and resp.leader != self.master_url:
                 # Follow the leader (the reference volume server redials
                 # whatever master the heartbeat response names). Track
@@ -285,6 +291,39 @@ class VolumeServer:
                 return
             if self._stop.is_set():
                 return
+
+    def _set_metrics_pusher(self, address: str) -> None:
+        """Start, retarget, or stop the push-gateway pusher per the
+        address the master advertised in its heartbeat response (an
+        empty address means the master runs without a gateway — stop
+        pushing rather than POSTing to a decommissioned endpoint
+        forever)."""
+        with self._lock:
+            if self._stop.is_set():
+                return
+            p = self._metrics_pusher
+            if p is not None and p.address == address:
+                return  # unchanged
+            if p is None and not address:
+                return  # nothing running, nothing requested
+            if p is not None:
+                p.stop()
+                self._metrics_pusher = None
+            if not address:
+                return  # gateway decommissioned: stay stopped
+            interval = 15.0
+            try:
+                cfg = self.master_stub().GetMasterConfiguration(
+                    master_pb2.GetMasterConfigurationRequest(),
+                    timeout=5)
+                if cfg.metrics_interval_seconds:
+                    interval = float(cfg.metrics_interval_seconds)
+            except Exception:  # noqa: BLE001 — default cadence is fine
+                pass
+            from ..util.stats import MetricsPusher
+            self._metrics_pusher = MetricsPusher(
+                self.metrics, address, "volume_server", self.url,
+                interval).start()
 
     def heartbeat_now(self) -> None:
         """One immediate snapshot push (tests / post-admin-op nudge)."""

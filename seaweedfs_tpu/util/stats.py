@@ -117,3 +117,62 @@ class Metrics:
                 lines.append(f"{full}_sum{lab} {m.total}")
                 lines.append(f"{full}_count{lab} {m.n}")
         return "\n".join(lines) + "\n"
+
+
+class MetricsPusher:
+    """Prometheus push-gateway client (weed/stats push mode): POSTs the
+    text exposition to ``http://<addr>/metrics/job/<job>/instance/<i>``
+    on an interval. Best-effort — an unreachable gateway is counted,
+    never fatal, and the interval keeps ticking."""
+
+    def __init__(self, metrics: "Metrics", address: str, job: str,
+                 instance: str, interval_seconds: float = 15.0):
+        import threading
+
+        self.metrics = metrics
+        self.address = address
+        self.job = job
+        self.instance = instance
+        self.interval = max(1.0, interval_seconds)
+        self.pushed = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def start(self) -> "MetricsPusher":
+        import threading
+
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"metrics-push-{self.job}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def push_once(self) -> bool:
+        import urllib.request
+
+        url = (f"http://{self.address}/metrics/job/{self.job}"
+               f"/instance/{self.instance}")
+        body = self.metrics.render().encode()
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "text/plain"})
+        try:
+            with urllib.request.urlopen(req, timeout=5):
+                self.pushed += 1
+                return True
+        except Exception:  # noqa: BLE001 — gateway may be down
+            self.errors += 1
+            return False
+
+    def _run(self) -> None:
+        # immediate first push, then the interval cadence
+        while not self._stop.is_set():
+            self.push_once()
+            if self._stop.wait(self.interval):
+                return

@@ -73,3 +73,78 @@ def test_glog_verbosity(capsys):
         glog.v(1, "shown %d", 2)
     finally:
         glog.set_verbosity(old)
+
+
+def test_metrics_push_gateway(tmp_path):
+    """Master + volume server push Prometheus text to a gateway; the
+    volume server learns the address from heartbeat responses."""
+    import socket
+    import threading
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.store import Store
+
+    def free_pair():
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if p + 10000 <= 65535:
+                try:
+                    with socket.socket() as s2:
+                        s2.bind(("127.0.0.1", p + 10000))
+                    return p
+                except OSError:
+                    continue
+
+    received = []
+
+    class GW(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n).decode()
+            received.append((self.path, body))
+            self.send_response(200)
+            self.end_headers()
+
+    gw = ThreadingHTTPServer(("127.0.0.1", 0), GW)
+    threading.Thread(target=gw.serve_forever, daemon=True).start()
+    gw_addr = f"127.0.0.1:{gw.server_address[1]}"
+
+    master = MasterServer(port=free_pair(), pulse_seconds=0.2, seed=1,
+                          garbage_threshold=0,
+                          metrics_address=gw_addr,
+                          metrics_interval_seconds=0.3).start()
+    master.metrics.counter("assign_requests").inc()
+    d = tmp_path / "mv"
+    d.mkdir()
+    vs = VolumeServer(Store([d], max_volumes=4), port=free_pair(),
+                      master_url=master.url, pulse_seconds=0.2).start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            jobs = {p.split("/")[3] for p, _ in received
+                    if p.startswith("/metrics/job/")}
+            if {"master", "volume_server"} <= jobs:
+                break
+            time.sleep(0.1)
+        jobs = {p.split("/")[3] for p, _ in received
+                if p.startswith("/metrics/job/")}
+        assert "master" in jobs, received[:2]
+        assert "volume_server" in jobs, "VS never learned the gateway"
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                "master_" in b for _, b in received):
+            time.sleep(0.1)
+        assert any("master_" in b for _, b in received), \
+            "no prometheus text body pushed"
+    finally:
+        vs.stop()
+        master.stop()
+        gw.shutdown()
