@@ -298,32 +298,41 @@ class VolumeServer:
         empty address means the master runs without a gateway — stop
         pushing rather than POSTing to a decommissioned endpoint
         forever)."""
+        # Decide under the lock, but do the blocking work (pusher-thread
+        # join, config rpc with a 5s deadline) OUTSIDE it — _channel()/
+        # peer_stub()/ec_shard_peers all share this lock, so holding it
+        # across a slow rpc would stall EC reads for seconds.
         with self._lock:
             if self._stop.is_set():
                 return
-            p = self._metrics_pusher
-            if p is not None and p.address == address:
+            old = self._metrics_pusher
+            if old is not None and old.address == address:
                 return  # unchanged
-            if p is None and not address:
+            if old is None and not address:
                 return  # nothing running, nothing requested
-            if p is not None:
-                p.stop()
-                self._metrics_pusher = None
-            if not address:
-                return  # gateway decommissioned: stay stopped
-            interval = 15.0
-            try:
-                cfg = self.master_stub().GetMasterConfiguration(
-                    master_pb2.GetMasterConfigurationRequest(),
-                    timeout=5)
-                if cfg.metrics_interval_seconds:
-                    interval = float(cfg.metrics_interval_seconds)
-            except Exception:  # noqa: BLE001 — default cadence is fine
-                pass
-            from ..util.stats import MetricsPusher
-            self._metrics_pusher = MetricsPusher(
-                self.metrics, address, "volume_server", self.url,
-                interval).start()
+            self._metrics_pusher = None
+        if old is not None:
+            old.stop()
+        if not address:
+            return  # gateway decommissioned: stay stopped
+        interval = 15.0
+        try:
+            cfg = self.master_stub().GetMasterConfiguration(
+                master_pb2.GetMasterConfigurationRequest(), timeout=5)
+            if cfg.metrics_interval_seconds:
+                interval = float(cfg.metrics_interval_seconds)
+        except Exception:  # noqa: BLE001 — default cadence is fine
+            pass
+        from ..util.stats import MetricsPusher
+        pusher = MetricsPusher(self.metrics, address, "volume_server",
+                               self.url, interval).start()
+        with self._lock:
+            if self._stop.is_set():
+                stale = pusher
+            else:
+                self._metrics_pusher, stale = pusher, None
+        if stale is not None:
+            stale.stop()
 
     def heartbeat_now(self) -> None:
         """One immediate snapshot push (tests / post-admin-op nudge)."""

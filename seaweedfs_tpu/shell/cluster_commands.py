@@ -436,6 +436,119 @@ def cmd_volume_vacuum(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"volume.vacuum: {vacuumed} volumes compacted")
 
 
+def _move_volume(env: ClusterEnv, vid: int, collection: str,
+                 src: str, dst: str) -> None:
+    """Relocate one volume: freeze on the source, VolumeCopy to the
+    destination, delete the source copy. A failed copy thaws the
+    source so it never sticks readonly (the move mechanics shared by
+    volume.balance and volume.move)."""
+    env.volume(src).VolumeMarkReadonly(
+        volume_server_pb2.VolumeMarkReadonlyRequest(
+            volume_id=vid, collection=collection))
+    try:
+        env.volume(dst).VolumeCopy(
+            volume_server_pb2.VolumeCopyRequest(
+                volume_id=vid, collection=collection,
+                source_data_node=src))
+    except Exception as e:
+        thaw = "source thawed"
+        try:
+            env.volume(src).VolumeMarkWritable(
+                volume_server_pb2.VolumeMarkWritableRequest(
+                    volume_id=vid, collection=collection))
+        except Exception as e2:  # noqa: BLE001 — report both
+            thaw = f"thaw also failed: {e2}"
+        raise ShellError(
+            f"copy of volume {vid} to {dst} failed ({e}); "
+            f"{thaw}") from e
+    env.volume(src).VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(
+            volume_id=vid, collection=collection))
+
+
+@cluster_command("volume.move")
+def cmd_volume_move(env: ClusterEnv, argv: list[str]) -> None:
+    """Relocate one volume between servers
+    (command_volume_move.go)."""
+    p = _parser("volume.move")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-source", required=True, help="source ip:port")
+    p.add_argument("-target", required=True, help="target ip:port")
+    args = p.parse_args(argv)
+    if args.source == args.target:
+        raise ShellError("volume.move: source and target are the same")
+    _move_volume(env, args.volumeId, args.collection, args.source,
+                 args.target)
+    env.println(f"volume.move: volume {args.volumeId} "
+                f"{args.source} -> {args.target}")
+
+
+@cluster_command("collection.list")
+def cmd_collection_list(env: ClusterEnv, argv: list[str]) -> None:
+    """List collections with volume counts and sizes
+    (command_collection_list.go)."""
+    p = _parser("collection.list")
+    p.parse_args(argv)
+    resp = env.volume_list()
+    agg: dict[str, list] = {}
+    ec_ids: dict[str, set] = {}
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for v in dn.volume_infos:
+                    a = agg.setdefault(v.collection, [0, 0])
+                    a[0] += 1
+                    a[1] += v.size
+                for s in dn.ec_shard_infos:
+                    agg.setdefault(s.collection, [0, 0])
+                    # distinct ids: shards of one EC volume spread over
+                    # several nodes must count as ONE ec volume
+                    ec_ids.setdefault(s.collection, set()).add(s.id)
+    for col in sorted(agg):
+        n, size = agg[col]
+        env.println(f"collection {col or '(default)'!s}: {n} volumes, "
+                    f"{size} bytes, "
+                    f"{len(ec_ids.get(col, ()))} ec volumes")
+
+
+@cluster_command("collection.delete")
+def cmd_collection_delete(env: ClusterEnv, argv: list[str]) -> None:
+    """Delete every volume and EC shard of a collection cluster-wide
+    (command_collection_delete.go)."""
+    p = _parser("collection.delete")
+    p.add_argument("-collection", required=True)
+    args = p.parse_args(argv)
+    col = args.collection
+    resp = env.volume_list()
+    deleted = 0
+    ec_deleted: set[int] = set()
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for v in dn.volume_infos:
+                    if v.collection != col:
+                        continue
+                    env.volume(dn.id).VolumeDelete(
+                        volume_server_pb2.VolumeDeleteRequest(
+                            volume_id=v.id, collection=col))
+                    deleted += 1
+                for s in dn.ec_shard_infos:
+                    if s.collection != col:
+                        continue
+                    ids = ShardBits(s.ec_index_bits).ids()
+                    env.volume(dn.id).VolumeEcShardsUnmount(
+                        volume_server_pb2.VolumeEcShardsUnmountRequest(
+                            volume_id=s.id, shard_ids=ids))
+                    env.volume(dn.id).VolumeEcShardsDelete(
+                        volume_server_pb2.VolumeEcShardsDeleteRequest(
+                            volume_id=s.id, collection=col,
+                            shard_ids=ids))
+                    ec_deleted.add(s.id)
+    env.println(f"collection.delete: {col}: {deleted} volumes, "
+                f"{len(ec_deleted)} ec volumes removed")
+
+
 @cluster_command("volume.balance")
 def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
     """Move whole volumes from loaded to free servers
@@ -466,34 +579,10 @@ def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
         if not movable:
             break
         v = movable[0]
-        # Freeze the source first: it is deleted right after the copy,
-        # so no write may land in between (VolumeCopy docstring).
-        env.volume(high_url).VolumeMarkReadonly(
-            volume_server_pb2.VolumeMarkReadonlyRequest(
-                volume_id=v.id, collection=v.collection))
         try:
-            env.volume(low_url).VolumeCopy(
-                volume_server_pb2.VolumeCopyRequest(
-                    volume_id=v.id, collection=v.collection,
-                    source_data_node=high_url))
-        except Exception as e:
-            # Thaw the source so a failed move never leaves the volume
-            # stuck readonly (Store.readonly is in-memory only). The
-            # thaw itself may fail (source down) — report both, never
-            # let it mask the original copy error.
-            thaw = "source thawed"
-            try:
-                env.volume(high_url).VolumeMarkWritable(
-                    volume_server_pb2.VolumeMarkWritableRequest(
-                        volume_id=v.id, collection=v.collection))
-            except Exception as e2:
-                thaw = f"thaw also failed: {e2}"
-            raise ShellError(
-                f"volume.balance: copy of volume {v.id} to {low_url} "
-                f"failed ({e}); {thaw}") from e
-        env.volume(high_url).VolumeDelete(
-            volume_server_pb2.VolumeDeleteRequest(
-                volume_id=v.id, collection=v.collection))
+            _move_volume(env, v.id, v.collection, high_url, low_url)
+        except ShellError as e:
+            raise ShellError(f"volume.balance: {e}") from e
         moved += 1
     env.println(f"volume.balance: moved {moved} volumes")
 

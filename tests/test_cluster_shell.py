@@ -160,3 +160,46 @@ def test_shell_cluster_status_and_grow(cluster):
     run_cluster_command(env, "volume.grow -count 2")
     assert "created volumes" in out.getvalue()
     env.close()
+
+
+def test_shell_volume_move_and_collections(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        # write into a named collection (grows a volume there)
+        a = operation.assign(mc, collection="photos")
+        operation.upload(a.url, a.fid, b"move-me", jwt=a.auth,
+                         collection="photos")
+        _settle(servers)
+        time.sleep(2 * PULSE)
+
+        env, out = _env(master)
+        run_cluster_command(env, "collection.list")
+        assert "photos" in out.getvalue()
+
+        # locate the volume and move it to a server that lacks it
+        vid = int(a.fid.split(",")[0])
+        src = a.url
+        dst = next(vs.url for vs in servers if vs.url != src)
+        run_cluster_command(
+            env, f"volume.move -volumeId {vid} -collection photos "
+                 f"-source {src} -target {dst}")
+        _settle(servers)
+        time.sleep(2 * PULSE)
+        # data is served from the new location
+        assert operation.download(mc, a.fid,
+                                  collection="photos") == b"move-me"
+        locs = [l["url"] for l in mc.lookup(vid, "photos")]
+        assert dst in locs and src not in locs
+
+        # collection.delete removes it cluster-wide
+        run_cluster_command(env,
+                            "collection.delete -collection photos")
+        _settle(servers)
+        time.sleep(2 * PULSE)
+        mc.invalidate()
+        with pytest.raises(KeyError):
+            mc.lookup(vid, "photos")
+        env.close()
+    finally:
+        mc.close()
