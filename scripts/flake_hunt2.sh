@@ -1,0 +1,11 @@
+#!/bin/bash
+cd /root/repo || exit 1
+for i in $(seq 1 6); do
+  timeout 500 python -m pytest tests/ -q --tb=long > artifacts/flake_run_$i.log 2>&1
+  tail -1 artifacts/flake_run_$i.log >> artifacts/flake_hunt2.log
+  if grep -q FAILED artifacts/flake_run_$i.log; then
+    echo "=== run $i failed ===" >> artifacts/flake_hunt2.log
+    grep -A40 "= FAILURES =" artifacts/flake_run_$i.log | head -60 >> artifacts/flake_hunt2.log
+  fi
+done
+echo done >> artifacts/flake_hunt2.log

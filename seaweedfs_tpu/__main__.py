@@ -100,6 +100,11 @@ def _run_export(argv: list[str]) -> int:
     return run_export(argv)
 
 
+def _run_watch(argv: list[str]) -> int:
+    from .volume_tools import run_watch
+    return run_watch(argv)
+
+
 def _run_webdav(argv: list[str]) -> int:
     from .gateway.webdav import main
     return main(argv)
@@ -121,6 +126,7 @@ COMMANDS = {
     "fix": _run_fix,
     "export": _run_export,
     "server": _run_server,
+    "watch": _run_watch,
     "compact": _run_compact,
     "scaffold": _run_scaffold,
 }

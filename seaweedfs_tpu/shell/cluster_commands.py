@@ -536,14 +536,13 @@ def cmd_collection_delete(env: ClusterEnv, argv: list[str]) -> None:
                 for s in dn.ec_shard_infos:
                     if s.collection != col:
                         continue
-                    ids = ShardBits(s.ec_index_bits).ids()
-                    env.volume(dn.id).VolumeEcShardsUnmount(
-                        volume_server_pb2.VolumeEcShardsUnmountRequest(
-                            volume_id=s.id, shard_ids=ids))
+                    # EcShardsDelete both unmounts (with the right
+                    # collection) and unlinks the shard files
                     env.volume(dn.id).VolumeEcShardsDelete(
                         volume_server_pb2.VolumeEcShardsDeleteRequest(
                             volume_id=s.id, collection=col,
-                            shard_ids=ids))
+                            shard_ids=ShardBits(
+                                s.ec_index_bits).ids()))
                     ec_deleted.add(s.id)
     env.println(f"collection.delete: {col}: {deleted} volumes, "
                 f"{len(ec_deleted)} ec volumes removed")

@@ -166,3 +166,49 @@ def run_export(argv: list[str] | None = None) -> int:
     n = export_volume(base, args.out)
     print(f"export: wrote {n} needles to {args.out}")
     return 0
+
+
+def run_watch(argv: list[str] | None = None) -> int:
+    """``weed watch -filer <host:port> [-pathPrefix /p]`` — tail the
+    filer's metadata stream to stdout (weed/command/watch.go)."""
+    import argparse
+    import json as json_mod
+
+    import grpc
+
+    from . import pb
+    from .cluster.master import _grpc_port
+    from .pb import filer_pb2
+
+    p = argparse.ArgumentParser(prog="watch")
+    p.add_argument("-filer", required=True)
+    p.add_argument("-pathPrefix", default="/")
+    args = p.parse_args(argv)
+    ip, http_port = args.filer.rsplit(":", 1)
+    ch = grpc.insecure_channel(f"{ip}:{_grpc_port(int(http_port))}")
+    stub = pb.filer_stub(ch)
+    stream = stub.SubscribeMetadata(filer_pb2.SubscribeMetadataRequest(
+        client_name="weed-watch", path_prefix=args.pathPrefix))
+    try:
+        for resp in stream:
+            note = resp.event_notification
+            kind = ("delete" if not note.new_entry.name else
+                    "create" if not note.old_entry.name else "update")
+            name = (note.new_entry.name or note.old_entry.name)
+            print(json_mod.dumps({
+                "tsNs": resp.ts_ns, "event": kind,
+                "path": f"{resp.directory.rstrip('/')}/{name}",
+                "size": max(note.new_entry.attributes.file_size,
+                            sum(c.size for c in note.new_entry.chunks)),
+            }), flush=True)
+    except KeyboardInterrupt:
+        pass
+    except grpc.RpcError as e:
+        # filer gone, or the stream lagged past the filer's queue
+        # bound — one clean line, not a traceback
+        print(f"watch: stream ended: "
+              f"{e.details() if hasattr(e, 'details') else e}")
+        return 1
+    finally:
+        ch.close()
+    return 0
