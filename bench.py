@@ -48,7 +48,7 @@ MIB = 1024 ** 2
 
 PROBE_TIMEOUT = 75       # backend-init watchdog, per attempt
 PROBE_ATTEMPTS = 2
-CORE_TIMEOUT = 600
+CORE_TIMEOUT = 1080
 CFG3_TIMEOUT = 480
 CFG5_TIMEOUT = 420
 SELF = os.path.abspath(__file__)
@@ -284,6 +284,63 @@ def _make_slabs(n_bufs: int, k: int, s: int, seed: int = 0):
             for _ in range(n_bufs)]
 
 
+def _fold_checksum(y):
+    """XOR-reduce an output to one (8, 128) u32 tile — used INSIDE jit.
+
+    Every output byte feeds the reduction, so fetching the folded tile
+    proves the whole encode ran; and because the fold lives in the same
+    executable as the encode, a timed call costs ONE dispatch (probe 1
+    measured ~8 ms per dispatch through the axon tunnel — the round-3
+    pattern of folding via separate un-jitted ops cost ~30 ms/call)."""
+    import jax
+    import jax.numpy as jnp
+    yw = jax.lax.bitcast_convert_type(
+        y.reshape(*y.shape[:-1], y.shape[-1] // 4, 4), jnp.uint32)
+    return jnp.bitwise_xor.reduce(yw.reshape(-1, 8, 128), axis=0)
+
+
+def _make_folded_fn(gf, coefs, nargs: int):
+    """jit of: acc, slabs -> acc ^ fold(parity of each slab).
+
+    One device dispatch per NARGS slabs: probe 2 showed the remote
+    compile ceiling is per-BUFFER (~160-256 MiB), not per-program, so
+    multiple slab-sized args amortize the per-dispatch cost that
+    dominates single-slab calls. Threading the accumulator THROUGH the
+    jit keeps the cross-call XOR chain on device without a separate
+    eager dispatch per call (each eager op costs another ~8 ms tunnel
+    round trip)."""
+    import jax
+
+    def f(acc, *xs):
+        assert len(xs) == nargs, f"group width {len(xs)} != nargs {nargs}"
+        for x in xs:
+            acc = acc ^ _fold_checksum(gf(coefs, x))
+        return acc
+
+    return jax.jit(f)
+
+
+def _time_folded(fn, groups, passes: int) -> float:
+    """Honest wall time: warm pass first, then `passes` passes over all
+    groups (distinct buffers), window closed by fetching the on-device
+    XOR accumulator's bytes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    zero = jax.device_put(jnp.zeros((8, 128), jnp.uint32))
+    acc = zero
+    for g in groups:  # warm: compile + touch every buffer
+        acc = fn(acc, *g)
+    np.asarray(acc)
+    t0 = time.perf_counter()
+    acc = zero
+    for _ in range(passes):
+        for g in groups:
+            acc = fn(acc, *g)
+    np.asarray(acc)
+    return time.perf_counter() - t0
+
+
 def _compile_or_shrink(make_fn, host_slabs, k, s, min_s=SLAB_MIN_S):
     """Compile make_fn(s) on slab 0; on failure halve the slab length and
     regenerate buffers. Returns (fn, device_slabs, s)."""
@@ -360,10 +417,10 @@ def child_core() -> None:
     # -- headline: ~1 GiB streamed through (1, 10, slab) device calls -----
     s = (SLAB_S0 // 2 if shrink else SLAB_S0) // seg * seg
     if interp:
-        s = seg  # interpreter is slow; one segment exercises the path
+        s = 2 * seg  # interpreter is slow; two segments exercise the path
     elif not on_acc:
         s = 2 * MIB  # CPU smoke scale; headline comes from native below
-    n_bufs = 2 if interp else max(2, min(7, -(-GIB // (k * s))))
+    n_bufs = 2 if interp or not on_acc else max(2, min(8, -(-GIB // (k * s))))
     host_slabs = _make_slabs(n_bufs, k, s)
     encode_fn, dev_slabs, s, host_slabs = _compile_or_shrink(
         make_encode, host_slabs, k, s)
@@ -373,37 +430,115 @@ def child_core() -> None:
     log(f"slab: (1, {k}, {s}) = {per_call / MIB:.0f} MiB input/call, "
         f"{n_bufs} distinct buffers")
 
-    timer = _ChecksumTimer()
+    # Candidate race over (kernel, slabs-per-dispatch), all sharing the
+    # already-uploaded device slabs (re-upload through the ~24 MiB/s
+    # tunnel would dwarf everything else). Probe-driven design:
+    #   probe 1: dispatch floor ~8 ms; in-jit fold 2.02 -> 3.21 GiB/s;
+    #   probe 2: per-call cost linear in S (kernel-bound ~5.5 GiB/s
+    #            marginal for the transpose kernel), compile ceiling is
+    #            per-buffer -> multi-arg dispatch compiles and pays;
+    #   SWAR kernel: transpose-free variant built to dodge the Mosaic
+    #            layout shuffling the probes implicate.
+    # Ordered safest-first so a compile hang (stage watchdog) can only
+    # cost the tail: every improvement is persisted the moment it lands.
     passes = 3 if on_acc else 1
-    # warm pass (all executables + buffers touched)
-    timer.start()
-    for d in dev_slabs:
-        timer.fold(encode_fn(d))
-    timer.stop()
-    timer.start()
-    for _ in range(passes):
-        for d in dev_slabs:
-            timer.fold(encode_fn(d))
-    t = timer.stop()
-    n_calls = passes * n_bufs
-    compute_gibps = n_calls * per_call / GIB / t
-    res["device_compute_gibps"] = round(compute_gibps, 3)
-    res["device_compute_bytes"] = n_calls * per_call
-    if on_acc:
-        # Persist the headline the moment it exists: a later sub-bench
-        # failing (or the watchdog firing) must not discard it.
-        res["headline_gibps"] = round(compute_gibps, 3)
-    log(f"device-resident encode: {n_calls} calls x {per_call / MIB:.0f} "
-        f"MiB in {t * 1e3:.1f} ms -> {compute_gibps:.2f} GiB/s "
-        f"(target {TARGET_GIBPS})")
-    _persist(res)
 
-    # optional profiler trace of one pass (never fatal)
+    def _swar64(c, x):
+        return rs_pallas.apply_gf_matrix_swar(c, x, rows_per_block=64)
+
+    def _swar512(c, x):
+        return rs_pallas.apply_gf_matrix_swar(c, x, rows_per_block=512)
+
+    if interp:
+        def _swar64(c, x):  # noqa: F811 — interpret-mode validation twin
+            return rs_pallas.apply_gf_matrix_swar(
+                c, x, rows_per_block=8, interpret=True)
+        _swar512 = None
+
+    swar_ok = False
+    if not on_acc:
+        candidates = []  # CPU headline comes from the native codec below
+    else:
+        # gate SWAR on device equality vs the (oracle-smoked) transpose
+        # kernel before racing it
+        try:
+            sw_gate = _swar64 if _swar512 is None else _swar512
+            y_t = encode_fn(dev_slabs[0])
+            y_s = jax.jit(lambda x: sw_gate(coefs, x))(dev_slabs[0])
+            eq = bool(np.asarray(jax.jit(
+                lambda a, b: (a == b).all())(y_t, y_s)))
+            if not eq:
+                raise AssertionError("SWAR parity != transpose-kernel parity")
+            swar_ok = True
+            res["swar_equal_ok"] = True
+            log("SWAR kernel on-device equality vs transpose kernel: OK")
+        except Exception as e:  # noqa: BLE001 — SWAR stays out of the race
+            res["swar_equal_error"] = f"{type(e).__name__}: {e}"[:200]
+            log(f"SWAR equality gate failed; racing transpose only: {e}")
+        candidates = [("transpose", gf_apply, 4), ("transpose", gf_apply, 1)]
+        if swar_ok:
+            candidates[1:1] = [("swar512", _swar512, 4), ("swar64", _swar64, 4)]
+    if interp:
+        candidates = [("transpose", gf_apply, 2)]
+        if swar_ok:
+            candidates.append(("swar8", _swar64, 2))
+
+    compute_gibps = 0.0
+    best_name = None
+    for name, gf, nargs in candidates:
+        tag = f"headline_{name}_n{nargs}_gibps"
+        try:
+            fn = _make_folded_fn(gf, coefs, nargs)
+            groups = [tuple(dev_slabs[i:i + nargs])
+                      for i in range(0, n_bufs - nargs + 1, nargs)]
+            if not groups:
+                raise ValueError(f"need >= {nargs} slabs, have {n_bufs}")
+            t = _time_folded(fn, groups, passes)
+            n_calls = passes * len(groups)
+            nbytes = n_calls * nargs * per_call
+            gibps = nbytes / GIB / t
+            res[tag] = round(gibps, 3)
+            log(f"  {name} x{nargs}/dispatch: {n_calls} calls x "
+                f"{nargs * per_call / MIB:.0f} MiB in {t * 1e3:.1f} ms -> "
+                f"{gibps:.2f} GiB/s")
+            if gibps > compute_gibps:
+                compute_gibps = gibps
+                best_name = f"{name}_n{nargs}"
+                res["device_compute_gibps"] = round(compute_gibps, 3)
+                res["device_compute_bytes"] = nbytes
+                res["device_compute_best"] = best_name
+                if on_acc:
+                    # Persist the headline the moment it exists: a later
+                    # sub-bench failing (or the watchdog firing) must
+                    # not discard it.
+                    res["headline_gibps"] = round(compute_gibps, 3)
+        except Exception as e:  # noqa: BLE001 — race survivors decide
+            res[tag] = None
+            log(f"  {name} x{nargs}/dispatch failed: "
+                f"{type(e).__name__}: {e}")
+        _persist(res)
+    if not candidates:  # degraded CPU path: single folded-call number
+        fn = _make_folded_fn(gf_apply, coefs, 1)
+        t = _time_folded(fn, [(d,) for d in dev_slabs], passes)
+        compute_gibps = passes * n_bufs * per_call / GIB / t
+        res["device_compute_gibps"] = round(compute_gibps, 3)
+        res["device_compute_bytes"] = passes * n_bufs * per_call
+        _persist(res)
+    elif best_name is None:
+        # Every racer failed (device died mid-stage?): die nonzero so
+        # the parent's shrink-retry / scrubbed-CPU fallback ladder runs
+        # instead of banking an empty "success".
+        raise RuntimeError("all headline candidates failed")
+    log(f"device-resident encode best ({best_name}): "
+        f"{compute_gibps:.2f} GiB/s (target {TARGET_GIBPS})")
+
+    # optional profiler trace of one pass of the plain encode (never fatal)
     try:
         trace_dir = os.path.join(ARTIFACTS, "jax_trace_r04")
+        timer = _ChecksumTimer()
         with jax.profiler.trace(trace_dir):
             timer.start()
-            for d in dev_slabs:
+            for d in dev_slabs[:2]:
                 timer.fold(encode_fn(d))
             timer.stop()
         res["profiler_trace"] = trace_dir
@@ -438,20 +573,21 @@ def child_core() -> None:
         f"({out_bytes[0] / MIB:.0f} MiB parity returned)")
     _persist(res)
 
+    # Fastest equality-gated kernel from the race drives the remaining
+    # device stages (falling back to the smoked transpose kernel).
+    best_gf = gf_apply
+    if best_name and best_name.startswith("swar512"):
+        best_gf = _swar512
+    elif best_name and best_name.startswith("swar"):
+        best_gf = _swar64
+
     # -- single-shard rebuild (config 2) ----------------------------------
     present = list(range(14))
     present.remove(13)
     rebuild_coefs = enc.decode_matrix_rows(present, [13])
-    rebuild_fn = jax.jit(lambda x: gf_apply(rebuild_coefs, x))
-    timer.start()
-    timer.fold(rebuild_fn(dev_slabs[0]))
-    timer.stop()  # warm
-    timer.start()
-    for _ in range(passes):
-        for d in dev_slabs:
-            timer.fold(rebuild_fn(d))
-    t_r = timer.stop()
-    rebuild_gibps = n_calls * per_call / GIB / t_r
+    rebuild_fn = _make_folded_fn(best_gf, rebuild_coefs, 1)
+    t_r = _time_folded(rebuild_fn, [(d,) for d in dev_slabs], passes)
+    rebuild_gibps = passes * n_bufs * per_call / GIB / t_r
     res["rebuild_1shard_gibps"] = round(rebuild_gibps, 3)
     log(f"single-shard rebuild: {rebuild_gibps:.2f} GiB/s (target 15)")
     _persist(res)
@@ -460,22 +596,17 @@ def child_core() -> None:
     for (ak, am) in ((6, 3), (12, 4)):
         try:
             aenc = Encoder(ak, am)
-            acoefs = aenc.parity_coefs
-            alt_fn = jax.jit(lambda v, _c=acoefs: gf_apply(_c, v))
             # Keep per-call input within the k=10 slab's verified
             # compile envelope (k*s bytes), whatever ak is — but never
-            # below one segment (ak > k at tiny s would hit zero).
-            a_s = max(seg, min(s, (k * s // ak) // seg * seg))
+            # below one granule. Granule 2*seg (256 KiB) satisfies every
+            # racer: transpose (128 KiB), swar64 (32 KiB), swar512
+            # (256 KiB).
+            gran = 2 * seg
+            a_s = max(gran, min(s, (k * s // ak) // gran * gran))
             a_host = _make_slabs(2, ak, a_s, seed=ak)
             a_dev = [jax.device_put(h) for h in a_host]
-            timer.start()
-            timer.fold(alt_fn(a_dev[0]))
-            timer.stop()  # warm
-            timer.start()
-            for _ in range(passes):
-                for d in a_dev:
-                    timer.fold(alt_fn(d))
-            t_a = timer.stop()
+            alt_fn = _make_folded_fn(best_gf, aenc.parity_coefs, 1)
+            t_a = _time_folded(alt_fn, [(d,) for d in a_dev], passes)
             alt_gibps = passes * len(a_dev) * ak * a_s / GIB / t_a
             res[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
             log(f"RS({ak},{am}) encode: {alt_gibps:.2f} GiB/s")

@@ -120,6 +120,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — record and move on
             probe["error"] = f"{type(e).__name__}: {e}"[:300]
             print(f"nb={nb}: FAILED {probe['error']}", flush=True)
+            res["probes"].append(probe)  # the failure IS the datum
             persist()
             break
         res["probes"].append(probe)

@@ -1,0 +1,133 @@
+"""Probe 2: where do the ~40 ms/call of kernel time go, and can one
+dispatch carry more bytes?
+
+Follow-up to tpu_scaling_probe.py (dispatch floor 7.9 ms; 160 MiB/call
+encode 48.7 ms/call = 3.2 GiB/s; (2, 10, 16 MiB) fails remote compile).
+Questions, each one probe section below:
+
+  A. Does per-call time scale with S (per-byte cost) or stay flat
+     (per-call overhead)?  S in {4, 8, 16} MiB at rb=8.
+  B. Does a taller grid block (rb in {8, 16, 32} at S=16 MiB) cut
+     per-grid-step overhead?  128 -> 64 -> 32 steps per call.
+  C. Is the remote-compile ceiling per-BUFFER or per-PROGRAM?  Same
+     320 MiB total as the failing (2, 10, 16Mi), shaped (2, 10, 8Mi)
+     and (4, 10, 4Mi).
+  D. Multi-arg single dispatch: f(x1..x4), four (1, 10, 16Mi) args,
+     four pallas calls inside one jit, checksum folded across all —
+     640 MiB per dispatch if the ceiling is per-buffer.
+
+Honest timing throughout: distinct buffers, warm pass, window closed by
+fetching an in-jit checksum. Results: artifacts/TPU_SCALING_PROBE2.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIB = 1 << 20
+GIB = 1 << 30
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "artifacts", "TPU_SCALING_PROBE2.json")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from seaweedfs_tpu.ops import rs_pallas
+    from seaweedfs_tpu.ops.rs_jax import Encoder
+
+    dev = jax.devices()[0]
+    res: dict = {"platform": dev.platform, "device": str(dev), "probes": []}
+    rng = np.random.default_rng(11)
+    k, m = 10, 4
+    coefs = Encoder(k, m).parity_coefs
+
+    def persist() -> None:
+        with open(OUT, "w") as f:
+            json.dump(res, f, indent=1)
+
+    def fold(y):
+        yw = jax.lax.bitcast_convert_type(
+            y.reshape(*y.shape[:-1], y.shape[-1] // 4, 4), jnp.uint32)
+        return jnp.bitwise_xor.reduce(yw.reshape(-1, 8, 128), axis=0)
+
+    def timed(tag: str, nb: int, s: int, rb: int = 8, nargs: int = 1) -> None:
+        probe = {"tag": tag, "nb": nb, "slab_mib": s / MIB, "rb": rb,
+                 "nargs": nargs, "input_mib": nargs * nb * k * s // MIB}
+        try:
+            if nargs == 1:
+                fn = jax.jit(lambda x: fold(
+                    rs_pallas.apply_gf_matrix(coefs, x, rb=rb)))
+            else:
+                def f(*xs):
+                    acc = None
+                    for x in xs:
+                        piece = fold(rs_pallas.apply_gf_matrix(
+                            coefs, x, rb=rb))
+                        acc = piece if acc is None else acc ^ piece
+                    return acc
+                fn = jax.jit(f)
+            bufs = []
+            for _ in range(2):
+                arg = tuple(
+                    jax.device_put(rng.integers(
+                        0, 256, size=(nb, k, s), dtype=np.uint8))
+                    for _ in range(nargs))
+                bufs.append(arg)
+            t0 = time.perf_counter()
+            acc = None
+            for arg in bufs:  # warm
+                piece = fn(*arg)
+                acc = piece if acc is None else acc ^ piece
+            np.asarray(acc)
+            probe["warm_s"] = round(time.perf_counter() - t0, 1)
+            passes = 3
+            t0 = time.perf_counter()
+            acc = None
+            for _ in range(passes):
+                for arg in bufs:
+                    piece = fn(*arg)
+                    acc = piece if acc is None else acc ^ piece
+            np.asarray(acc)
+            t = time.perf_counter() - t0
+            n_calls = passes * len(bufs)
+            nbytes = n_calls * nargs * nb * k * s
+            probe["calls"] = n_calls
+            probe["ms_per_call"] = round(t / n_calls * 1e3, 1)
+            probe["gibps"] = round(nbytes / GIB / t, 2)
+            print(f"{tag}: nb={nb} s={s / MIB:g}Mi rb={rb} nargs={nargs} "
+                  f"{probe['input_mib']:5d} MiB/call "
+                  f"{probe['ms_per_call']:7.1f} ms/call -> "
+                  f"{probe['gibps']:.2f} GiB/s", flush=True)
+            del bufs
+        except Exception as e:  # noqa: BLE001
+            probe["error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"{tag}: FAILED {probe['error']}", flush=True)
+        res["probes"].append(probe)
+        persist()
+
+    # A: per-byte vs per-call
+    timed("A.s4", 1, 4 * MIB)
+    timed("A.s8", 1, 8 * MIB)
+    timed("A.s16", 1, 16 * MIB)
+    # B: taller blocks (fewer grid steps)
+    timed("B.rb16", 1, 16 * MIB, rb=16)
+    timed("B.rb32", 1, 16 * MIB, rb=32)
+    # C: compile ceiling shape-dependence (same 320 MiB total)
+    timed("C.2x8", 2, 8 * MIB)
+    timed("C.4x4", 4, 4 * MIB)
+    # D: multi-arg single dispatch
+    timed("D.2arg", 1, 16 * MIB, nargs=2)
+    timed("D.4arg", 1, 16 * MIB, nargs=4)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

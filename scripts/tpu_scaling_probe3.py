@@ -1,0 +1,135 @@
+"""Probe 3: SWAR (transpose-free) kernel vs the bit-transpose kernel.
+
+Probe2 found the transpose kernel's marginal cost ~0.18 ms/MiB
+(~5.5 GiB/s) with ~14 ms fixed per call — ~150x above the HBM floor,
+suggesting Mosaic lowers the reshape/stack/slice-heavy 32x32 bit
+transposes into VMEM copies. This probe times:
+
+  A. SWAR kernel at S in {4, 16} MiB, rows_per_block in {256, 512, 1024}
+  B. SWAR multi-arg dispatch (2 and 4 args x 160 MiB)
+  C. on-device correctness spot-check of SWAR vs the transpose kernel
+
+Results: artifacts/TPU_SCALING_PROBE3.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIB = 1 << 20
+GIB = 1 << 30
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "artifacts", "TPU_SCALING_PROBE3.json")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from seaweedfs_tpu.ops import rs_pallas
+    from seaweedfs_tpu.ops.rs_jax import Encoder
+
+    dev = jax.devices()[0]
+    res: dict = {"platform": dev.platform, "device": str(dev), "probes": []}
+    rng = np.random.default_rng(13)
+    k, m = 10, 4
+    coefs = Encoder(k, m).parity_coefs
+
+    def persist() -> None:
+        with open(OUT, "w") as f:
+            json.dump(res, f, indent=1)
+
+    def fold(y):
+        yw = jax.lax.bitcast_convert_type(
+            y.reshape(*y.shape[:-1], y.shape[-1] // 4, 4), jnp.uint32)
+        return jnp.bitwise_xor.reduce(yw.reshape(-1, 8, 128), axis=0)
+
+    # -- C: on-device SWAR vs transpose-kernel equality -------------------
+    # rows_per_block=64 keeps the unrolled program small for the first
+    # remote compile (the rpb=512 variant hung the compile helper once;
+    # unconfirmed whether that was program size or the tunnel dropping).
+    try:
+        s0 = 2 * MIB
+        x0 = rng.integers(0, 256, size=(1, k, s0), dtype=np.uint8)
+        xd = jax.device_put(x0)
+        y_t = np.asarray(jax.jit(
+            lambda x: rs_pallas.apply_gf_matrix(coefs, x))(xd))
+        y_s = np.asarray(jax.jit(lambda x: rs_pallas.apply_gf_matrix_swar(
+            coefs, x, rows_per_block=64))(xd))
+        res["device_equal"] = bool((y_t == y_s).all())
+        print(f"device SWAR == transpose-kernel: {res['device_equal']}",
+              flush=True)
+        if not res["device_equal"]:
+            persist()
+            return 1
+    except Exception as e:  # noqa: BLE001
+        res["device_equal_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"equality check FAILED {res['device_equal_error']}", flush=True)
+    persist()
+
+    def timed(tag: str, s: int, rpb: int, nargs: int = 1) -> None:
+        probe = {"tag": tag, "slab_mib": s / MIB, "rows_per_block": rpb,
+                 "nargs": nargs, "input_mib": nargs * k * s // MIB}
+        try:
+            def f(acc, *xs):
+                # accumulator threaded through the jit: one dispatch per
+                # call, no eager cross-call XOR (each eager op is ~8 ms
+                # of tunnel round trip)
+                for x in xs:
+                    acc = acc ^ fold(rs_pallas.apply_gf_matrix_swar(
+                        coefs, x, rows_per_block=rpb))
+                return acc
+            fn = jax.jit(f)
+            zero = jax.device_put(jnp.zeros((8, 128), jnp.uint32))
+            bufs = [tuple(jax.device_put(rng.integers(
+                        0, 256, size=(1, k, s), dtype=np.uint8))
+                    for _ in range(nargs)) for _ in range(2)]
+            t0 = time.perf_counter()
+            acc = zero
+            for arg in bufs:  # warm
+                acc = fn(acc, *arg)
+            np.asarray(acc)
+            probe["warm_s"] = round(time.perf_counter() - t0, 1)
+            passes = 3
+            t0 = time.perf_counter()
+            acc = zero
+            for _ in range(passes):
+                for arg in bufs:
+                    acc = fn(acc, *arg)
+            np.asarray(acc)
+            t = time.perf_counter() - t0
+            n_calls = passes * len(bufs)
+            nbytes = n_calls * nargs * k * s
+            probe["calls"] = n_calls
+            probe["ms_per_call"] = round(t / n_calls * 1e3, 1)
+            probe["gibps"] = round(nbytes / GIB / t, 2)
+            print(f"{tag}: s={s / MIB:g}Mi rpb={rpb} nargs={nargs} "
+                  f"{probe['input_mib']:5d} MiB/call "
+                  f"{probe['ms_per_call']:7.1f} ms/call -> "
+                  f"{probe['gibps']:.2f} GiB/s", flush=True)
+            del bufs
+        except Exception as e:  # noqa: BLE001
+            probe["error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"{tag}: FAILED {probe['error']}", flush=True)
+        res["probes"].append(probe)
+        persist()
+
+    # Small blocks first: compile-safe, and the S-intercept separates
+    # per-call overhead from per-byte kernel cost for SWAR.
+    timed("A.s4.rpb64", 4 * MIB, 64)
+    timed("A.s16.rpb64", 16 * MIB, 64)
+    timed("A.s16.rpb256", 16 * MIB, 256)
+    timed("B.2arg", 16 * MIB, 64, nargs=2)
+    timed("B.4arg", 16 * MIB, 64, nargs=4)
+    timed("B.8arg", 16 * MIB, 64, nargs=8)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

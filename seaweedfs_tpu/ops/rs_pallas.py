@@ -100,26 +100,139 @@ def _make_kernel(rows: tuple[tuple[int, ...], ...], n_in: int, n_out: int):
     return kernel
 
 
-def conforms(s: int) -> bool:
+def _make_swar_kernel(rows: tuple[tuple[int, ...], ...],
+                      n_in: int, n_out: int):
+    """Transpose-free kernel: SWAR bitplanes inside u32 words.
+
+    Bit j of each of the 4 packed bytes of a word is extracted with
+    ``(x >> j) & 0x01010101`` — plane t = 8d+j holds its 4 bits at word
+    positions {j? no: 0, 8, 16, 24}. The GF(2) XOR network then runs on
+    these quarter-density planes, and output bit i re-enters the word at
+    ``acc << i`` (disjoint positions across i, so OR == ADD == XOR).
+    Every op is a full-width shift/AND/XOR on the (rows, 128) u32 tile:
+    no reshapes, slices along sub-tile axes, stacks, or transposes for
+    Mosaic to lower into VMEM copies — probe2 measured the transpose
+    variant at ~5.5 GiB/s marginal, ~150x below HBM, pointing at
+    layout-shuffling rather than XOR arithmetic as the cost.
+    """
+
+    # Invert rows (out-plane -> in-planes) to in-plane -> out-planes so
+    # the loop can run input-shard-major: only the 8 planes of the
+    # current shard plus the 8*n_out accumulators are live at once
+    # (vs all 8*n_in planes at once), easing compiler live-range
+    # pressure on the fully unrolled body.
+    sinks: list[list[int]] = [[] for _ in range(8 * n_in)]
+    for r, idx in enumerate(rows):
+        for t in idx:
+            sinks[t].append(r)
+
+    def kernel(in_ref, out_ref):
+        plane_mask = jnp.uint32(0x01010101)
+        x = in_ref[0]                       # (n_in, rows, 128) u32
+        accs: list = [None] * (8 * n_out)
+        for d in range(n_in):
+            xd = x[d]
+            for j in range(8):
+                outs = sinks[8 * d + j]
+                if not outs:
+                    continue
+                p = xd if j == 0 else (xd >> jnp.uint32(j))
+                p = p & plane_mask
+                for r in outs:
+                    accs[r] = p if accs[r] is None else (accs[r] ^ p)
+        for o in range(n_out):
+            y = None
+            for i in range(8):
+                acc = accs[8 * o + i]
+                if acc is None:
+                    continue
+                sh = acc if i == 0 else (acc << jnp.uint32(i))
+                y = sh if y is None else (y | sh)
+            if y is None:
+                y = jnp.zeros_like(x[0])
+            out_ref[0, o] = y
+
+    return kernel
+
+
+#: Row granularity of the SWAR kernel: S must divide into
+#: 4 (bytes/word) * SWAR_ROWS * 128 (lanes) byte segments.
+SWAR_ROWS = 512
+SWAR_SEG_BYTES = 4 * SWAR_ROWS * LANES
+
+
+def swar_conforms(s: int, rows_per_block: int = SWAR_ROWS) -> bool:
+    return s > 0 and s % (4 * rows_per_block * LANES) == 0
+
+
+def apply_gf_matrix_swar(coefs: np.ndarray, x: jnp.ndarray,
+                         interpret: bool = False,
+                         rows_per_block: int = SWAR_ROWS) -> jnp.ndarray:
+    """Same contract as apply_gf_matrix, via the SWAR kernel."""
+    n_out, n_in = coefs.shape
+    if x.ndim != 3 or x.shape[1] != n_in:
+        raise ValueError(f"x must be (B, {n_in}, S), got {x.shape}")
+    b, _, s = x.shape
+    if not swar_conforms(s, rows_per_block):
+        raise ValueError(
+            f"S={s} must be a positive multiple of "
+            f"{4 * rows_per_block * LANES}")
+    w = s // 4
+    r = w // LANES
+
+    mbits = bitslice.expand_gf2(np.asarray(coefs, dtype=np.uint8))
+    rows = tuple(tuple(int(t) for t in np.nonzero(mbits[rr])[0])
+                 for rr in range(8 * n_out))
+
+    xw = jax.lax.bitcast_convert_type(
+        x.reshape(b, n_in, w, 4), jnp.uint32)
+    x4 = xw.reshape(b, n_in, r, LANES)
+
+    y4 = pl.pallas_call(
+        _make_swar_kernel(rows, n_in, n_out),
+        grid=(b, r // rows_per_block),
+        in_specs=[pl.BlockSpec(
+            (1, n_in, rows_per_block, LANES),
+            lambda bi, ri: (bi, 0, ri, 0),
+            memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(
+            (1, n_out, rows_per_block, LANES),
+            lambda bi, ri: (bi, 0, ri, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (b, n_out, r, LANES), jnp.uint32),
+        interpret=interpret,
+    )(x4)
+
+    yw = y4.reshape(b, n_out, w)
+    return jax.lax.bitcast_convert_type(yw, jnp.uint8).reshape(b, n_out, s)
+
+
+def conforms(s: int, rb: int = RB) -> bool:
     """True when a shard length S can feed the kernel without padding."""
-    return s > 0 and s % SEG_BYTES == 0
+    seg = 4 * GROUP_WORDS * rb * LANES
+    return s > 0 and s % seg == 0
 
 
 def apply_gf_matrix(coefs: np.ndarray, x: jnp.ndarray,
-                    interpret: bool = False) -> jnp.ndarray:
+                    interpret: bool = False, rb: int = RB) -> jnp.ndarray:
     """y[b, o, s] = XOR_d coefs[o, d] * x[b, d, s] over GF(2^8), fused.
 
     ``coefs`` (n_out, n_in) uint8 static; ``x`` (B, n_in, S) uint8 with
-    S % SEG_BYTES == 0. Trace-time work (bit-matrix expansion, kernel
-    construction) is cached per coefficient matrix; call under jit or
-    rely on jit's own executable cache.
+    S % (4 * 32 * rb * 128) == 0. ``rb`` is the block height in u32
+    sublane rows per grid step — VMEM per step is
+    (n_in + n_out) * 32 * rb * 128 * 4 bytes, double-buffered; keep it
+    well under the ~16 MiB/core VMEM budget. Trace-time work (bit-matrix
+    expansion, kernel construction) is cached per coefficient matrix;
+    call under jit or rely on jit's own executable cache.
     """
     n_out, n_in = coefs.shape
     if x.ndim != 3 or x.shape[1] != n_in:
         raise ValueError(f"x must be (B, {n_in}, S), got {x.shape}")
     b, _, s = x.shape
-    if not conforms(s):
-        raise ValueError(f"S={s} must be a positive multiple of {SEG_BYTES}")
+    if not conforms(s, rb):
+        seg = 4 * GROUP_WORDS * rb * LANES
+        raise ValueError(f"S={s} must be a positive multiple of {seg}")
     w = s // 4
     r = w // (GROUP_WORDS * LANES)
 
@@ -133,13 +246,13 @@ def apply_gf_matrix(coefs: np.ndarray, x: jnp.ndarray,
 
     y4 = pl.pallas_call(
         _make_kernel(rows, n_in, n_out),
-        grid=(b, r // RB),
+        grid=(b, r // rb),
         in_specs=[pl.BlockSpec(
-            (1, n_in, GROUP_WORDS, RB, LANES),
+            (1, n_in, GROUP_WORDS, rb, LANES),
             lambda bi, ri: (bi, 0, 0, ri, 0),
             memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(
-            (1, n_out, GROUP_WORDS, RB, LANES),
+            (1, n_out, GROUP_WORDS, rb, LANES),
             lambda bi, ri: (bi, 0, 0, ri, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(

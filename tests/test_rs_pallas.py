@@ -69,6 +69,45 @@ def test_conforms_and_shape_errors():
             enc.parity_coefs, jnp.zeros((1, 3, SEG), jnp.uint8))
 
 
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3)])
+def test_swar_kernel_matches_oracle(k, m):
+    """The transpose-free SWAR kernel (in-word bitplanes) is bit-exact."""
+    rng = np.random.default_rng(k + m)
+    seg = 4 * 8 * 128  # rows_per_block=8 keeps interpret tractable
+    x = rng.integers(0, 256, (1, k, 2 * seg), dtype=np.uint8)
+    enc = rs_jax.Encoder(k, m)
+    got = np.asarray(rs_pallas.apply_gf_matrix_swar(
+        enc.parity_coefs, jnp.asarray(x), interpret=True, rows_per_block=8))
+    np.testing.assert_array_equal(got, _oracle_parity(x, k, m))
+
+
+def test_swar_kernel_reconstruct_rows():
+    rng = np.random.default_rng(9)
+    seg = 4 * 8 * 128
+    x = rng.integers(0, 256, (1, 10, seg), dtype=np.uint8)
+    enc = rs_jax.Encoder(10, 4)
+    parity = _oracle_parity(x, 10, 4)
+    full = np.concatenate([x, parity], axis=1)
+    present = [0, 1, 2, 3, 4, 6, 7, 8, 9, 10]  # lost shards 5, 11-13
+    rows = enc.decode_matrix_rows(present, [5, 13])
+    surv = np.ascontiguousarray(full[:, present, :])
+    got = np.asarray(rs_pallas.apply_gf_matrix_swar(
+        rows, jnp.asarray(surv[:, :10, :]), interpret=True,
+        rows_per_block=8))
+    np.testing.assert_array_equal(got, full[:, [5, 13], :])
+
+
+def test_swar_conforms_and_errors():
+    assert rs_pallas.swar_conforms(rs_pallas.SWAR_SEG_BYTES)
+    assert rs_pallas.swar_conforms(4 * 8 * 128, rows_per_block=8)
+    assert not rs_pallas.swar_conforms(0)
+    assert not rs_pallas.swar_conforms(4 * 8 * 128 - 4, rows_per_block=8)
+    enc = rs_jax.Encoder(4, 2)
+    with pytest.raises(ValueError):
+        rs_pallas.apply_gf_matrix_swar(
+            enc.parity_coefs, jnp.zeros((1, 4, 256), jnp.uint8))
+
+
 def test_chunked_xla_path_matches(monkeypatch):
     """apply_matrix's lax.map column chunking is bit-transparent."""
     monkeypatch.setattr(rs_jax, "FORCE", "xla")
