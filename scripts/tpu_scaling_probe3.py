@@ -29,7 +29,6 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 def main() -> int:
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from seaweedfs_tpu.ops import rs_pallas
@@ -45,10 +44,8 @@ def main() -> int:
         with open(OUT, "w") as f:
             json.dump(res, f, indent=1)
 
-    def fold(y):
-        yw = jax.lax.bitcast_convert_type(
-            y.reshape(*y.shape[:-1], y.shape[-1] // 4, 4), jnp.uint32)
-        return jnp.bitwise_xor.reduce(yw.reshape(-1, 8, 128), axis=0)
+    # fold/timing honesty shared with the benchmark — one implementation
+    from bench import _make_folded_fn, _time_folded
 
     # -- C: on-device SWAR vs transpose-kernel equality -------------------
     # rows_per_block=64 keeps the unrolled program small for the first
@@ -77,34 +74,15 @@ def main() -> int:
         probe = {"tag": tag, "slab_mib": s / MIB, "rows_per_block": rpb,
                  "nargs": nargs, "input_mib": nargs * k * s // MIB}
         try:
-            def f(acc, *xs):
-                # accumulator threaded through the jit: one dispatch per
-                # call, no eager cross-call XOR (each eager op is ~8 ms
-                # of tunnel round trip)
-                for x in xs:
-                    acc = acc ^ fold(rs_pallas.apply_gf_matrix_swar(
-                        coefs, x, rows_per_block=rpb))
-                return acc
-            fn = jax.jit(f)
-            zero = jax.device_put(jnp.zeros((8, 128), jnp.uint32))
-            bufs = [tuple(jax.device_put(rng.integers(
+            fn = _make_folded_fn(
+                lambda c, x: rs_pallas.apply_gf_matrix_swar(
+                    c, x, rows_per_block=rpb), coefs, nargs)
+            groups = [tuple(jax.device_put(rng.integers(
                         0, 256, size=(1, k, s), dtype=np.uint8))
                     for _ in range(nargs)) for _ in range(2)]
-            t0 = time.perf_counter()
-            acc = zero
-            for arg in bufs:  # warm
-                acc = fn(acc, *arg)
-            np.asarray(acc)
-            probe["warm_s"] = round(time.perf_counter() - t0, 1)
             passes = 3
-            t0 = time.perf_counter()
-            acc = zero
-            for _ in range(passes):
-                for arg in bufs:
-                    acc = fn(acc, *arg)
-            np.asarray(acc)
-            t = time.perf_counter() - t0
-            n_calls = passes * len(bufs)
+            t = _time_folded(fn, groups, passes)
+            n_calls = passes * len(groups)
             nbytes = n_calls * nargs * k * s
             probe["calls"] = n_calls
             probe["ms_per_call"] = round(t / n_calls * 1e3, 1)
@@ -113,7 +91,7 @@ def main() -> int:
                   f"{probe['input_mib']:5d} MiB/call "
                   f"{probe['ms_per_call']:7.1f} ms/call -> "
                   f"{probe['gibps']:.2f} GiB/s", flush=True)
-            del bufs
+            del groups
         except Exception as e:  # noqa: BLE001
             probe["error"] = f"{type(e).__name__}: {e}"[:200]
             print(f"{tag}: FAILED {probe['error']}", flush=True)

@@ -29,9 +29,12 @@ while true; do
     touch artifacts/tpu.lock
     if [ ! -f artifacts/TPU_SCALING_PROBE3.done ]; then
       timeout 1500 python scripts/tpu_scaling_probe3.py \
-        >> artifacts/scaling_probe3.log 2>&1 \
-        && touch artifacts/TPU_SCALING_PROBE3.done
-      echo "$TS probe3 rc=$?" >> "$LOG"
+        >> artifacts/scaling_probe3.log 2>&1
+      PRC=$?
+      # attempt marker regardless of rc: a hanging probe must burn at
+      # most ONE window, never every window
+      echo "rc=$PRC at $TS" > artifacts/TPU_SCALING_PROBE3.done
+      echo "$TS probe3 rc=$PRC" >> "$LOG"
     fi
     timeout 2400 python bench.py \
       > "artifacts/BENCH_attempt_$TS.json" \

@@ -106,7 +106,7 @@ def _make_swar_kernel(rows: tuple[tuple[int, ...], ...],
 
     Bit j of each of the 4 packed bytes of a word is extracted with
     ``(x >> j) & 0x01010101`` — plane t = 8d+j holds its 4 bits at word
-    positions {j? no: 0, 8, 16, 24}. The GF(2) XOR network then runs on
+    bit positions 0, 8, 16, 24. The GF(2) XOR network then runs on
     these quarter-density planes, and output bit i re-enters the word at
     ``acc << i`` (disjoint positions across i, so OR == ADD == XOR).
     Every op is a full-width shift/AND/XOR on the (rows, 128) u32 tile:
