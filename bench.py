@@ -320,25 +320,29 @@ def _make_folded_fn(gf, coefs, nargs: int):
     return jax.jit(f)
 
 
-def _time_folded(fn, groups, passes: int) -> float:
+def _time_folded(fn, groups, passes: int) -> tuple[float, float]:
     """Honest wall time: warm pass first, then `passes` passes over all
     groups (distinct buffers), window closed by fetching the on-device
-    XOR accumulator's bytes."""
+    XOR accumulator's bytes. Returns (timed_seconds, warm_seconds) —
+    warm covers compile + first touch, a datum in its own right when
+    comparing kernel variants' compile costs."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     zero = jax.device_put(jnp.zeros((8, 128), jnp.uint32))
+    t_w = time.perf_counter()
     acc = zero
     for g in groups:  # warm: compile + touch every buffer
         acc = fn(acc, *g)
     np.asarray(acc)
+    warm_s = time.perf_counter() - t_w
     t0 = time.perf_counter()
     acc = zero
     for _ in range(passes):
         for g in groups:
             acc = fn(acc, *g)
     np.asarray(acc)
-    return time.perf_counter() - t0
+    return time.perf_counter() - t0, warm_s
 
 
 def _compile_or_shrink(make_fn, host_slabs, k, s, min_s=SLAB_MIN_S):
@@ -498,6 +502,21 @@ def child_core() -> None:
             continue
         if name.startswith("swar") and not swar_ok:
             continue
+        if name == "swar512":
+            # the small-block gate does not cover this variant; equality-
+            # check it too before it may win the race / drive later
+            # stages (runs dead last, a transpose headline is banked)
+            try:
+                y_t = encode_fn(dev_slabs[0])
+                y_5 = jax.jit(lambda x: _swar512(coefs, x))(dev_slabs[0])
+                if not bool(np.asarray(jax.jit(
+                        lambda a, b: (a == b).all())(y_t, y_5))):
+                    raise AssertionError("swar512 parity mismatch")
+            except Exception as e:  # noqa: BLE001
+                res["swar512_equal_error"] = f"{type(e).__name__}: {e}"[:200]
+                log(f"  swar512 equality/compile failed; skipping: {e}")
+                _persist(res)
+                continue
         tag = f"headline_{name}_n{nargs}_gibps"
         try:
             fn = _make_folded_fn(gf, coefs, nargs)
@@ -505,7 +524,8 @@ def child_core() -> None:
                       for i in range(0, n_bufs - nargs + 1, nargs)]
             if not groups:
                 raise ValueError(f"need >= {nargs} slabs, have {n_bufs}")
-            t = _time_folded(fn, groups, passes)
+            t, warm_s = _time_folded(fn, groups, passes)
+            res[tag.replace("_gibps", "_warm_s")] = round(warm_s, 1)
             n_calls = passes * len(groups)
             nbytes = n_calls * nargs * per_call
             gibps = nbytes / GIB / t
@@ -531,7 +551,7 @@ def child_core() -> None:
         _persist(res)
     if not candidates:  # degraded CPU path: single folded-call number
         fn = _make_folded_fn(gf_apply, coefs, 1)
-        t = _time_folded(fn, [(d,) for d in dev_slabs], passes)
+        t, _ = _time_folded(fn, [(d,) for d in dev_slabs], passes)
         compute_gibps = passes * n_bufs * per_call / GIB / t
         res["device_compute_gibps"] = round(compute_gibps, 3)
         res["device_compute_bytes"] = passes * n_bufs * per_call
@@ -598,7 +618,7 @@ def child_core() -> None:
     present.remove(13)
     rebuild_coefs = enc.decode_matrix_rows(present, [13])
     rebuild_fn = _make_folded_fn(best_gf, rebuild_coefs, 1)
-    t_r = _time_folded(rebuild_fn, [(d,) for d in dev_slabs], passes)
+    t_r, _ = _time_folded(rebuild_fn, [(d,) for d in dev_slabs], passes)
     rebuild_gibps = passes * n_bufs * per_call / GIB / t_r
     res["rebuild_1shard_gibps"] = round(rebuild_gibps, 3)
     log(f"single-shard rebuild: {rebuild_gibps:.2f} GiB/s (target 15)")
@@ -618,7 +638,7 @@ def child_core() -> None:
             a_host = _make_slabs(2, ak, a_s, seed=ak)
             a_dev = [jax.device_put(h) for h in a_host]
             alt_fn = _make_folded_fn(best_gf, aenc.parity_coefs, 1)
-            t_a = _time_folded(alt_fn, [(d,) for d in a_dev], passes)
+            t_a, _ = _time_folded(alt_fn, [(d,) for d in a_dev], passes)
             alt_gibps = passes * len(a_dev) * ak * a_s / GIB / t_a
             res[f"rs_{ak}_{am}_encode_gibps"] = round(alt_gibps, 3)
             log(f"RS({ak},{am}) encode: {alt_gibps:.2f} GiB/s")
@@ -821,20 +841,29 @@ def child_config3() -> None:
     from seaweedfs_tpu.pipeline.scheme import DEFAULT_SCHEME
 
     # -- batch census on a subset, scaled to the full workload ------------
+    # Full batches (those that hit the bound's row cap) scale with the
+    # volume count; the end-of-stream tail flush happens ONCE however
+    # many volumes stream through, so it is counted once, unscaled —
+    # scaling it would skew the timed batch mix toward the tail shape.
     census_n = 40
     census_src = ((i, pool[i % pool_n]) for i in range(census_n))
     shapes: dict = {}
     for spans, packed in batch_mod.iter_packed_batches(
             census_src, max_batch_bytes=max_batch):
+        rows_cap = max(1, max_batch // (packed.shape[1] * packed.shape[2]))
+        full = packed.shape[0] >= rows_cap
         key = packed.shape
         ent = shapes.setdefault(key, {"batches": 0, "bytes": 0,
-                                      "proto": packed})
+                                      "full": full, "proto": packed})
         ent["batches"] += 1
         ent["bytes"] += packed.size
     scale = n_volumes / census_n
-    total_bytes = int(sum(e["bytes"] for e in shapes.values()) * scale)
-    log("config-3 batch census (x{:.0f} scale): ".format(scale) + ", ".join(
-        f"{v['batches']}x{k}" for k, v in shapes.items()))
+    total_bytes = int(sum(
+        e["bytes"] * (scale if e["full"] else 1) for e in shapes.values()))
+    log("config-3 batch census (x{:.0f} scale on full batches): ".format(
+        scale) + ", ".join(
+        f"{v['batches']}x{k}{'' if v['full'] else ' (tail)'}"
+        for k, v in shapes.items()))
 
     # -- device-resident aggregate over those shapes ----------------------
     import jax.numpy as jnp
@@ -846,7 +875,8 @@ def child_config3() -> None:
     t_total = 0.0
     n_distinct = 4
     for shape, ent in shapes.items():
-        n_calls = max(1, round(ent["batches"] * scale))
+        n_calls = max(1, round(ent["batches"] * scale)) if ent["full"] \
+            else ent["batches"]
         proto = ent["proto"]
         # distinct buffers via cheap byte-XOR (a permutation would cost
         # minutes of host time at these sizes)
@@ -869,8 +899,9 @@ def child_config3() -> None:
         t_total += time.perf_counter() - t0
     gibps = total_bytes / GIB / t_total
     res["many_volumes_gibps"] = round(gibps, 3)
-    res["many_volumes_batches"] = int(
-        sum(round(e["batches"] * scale) for e in shapes.values()))
+    res["many_volumes_batches"] = int(sum(
+        round(e["batches"] * scale) if e["full"] else e["batches"]
+        for e in shapes.values()))
     log(f"config-3 device-resident aggregate ({n_volumes} x "
         f"{vol_bytes / MIB:.0f} MB as {res['many_volumes_batches']} "
         f"coalesced batches): {t_total:.2f} s -> {gibps:.2f} GiB/s")
@@ -911,26 +942,31 @@ def child_config5() -> None:
     res: dict = {}
 
     if on_acc:
-        import jax
+        # Guarded: a compile failure here must not cost the (previously
+        # working) repair harness numbers below.
+        try:
+            import jax
 
-        from seaweedfs_tpu.ops import rs_pallas
+            from seaweedfs_tpu.ops import rs_pallas
 
-        enc = DEFAULT_SCHEME.encoder
-        k, total = enc.data_shards, enc.data_shards + enc.parity_shards
-        lost = list(repair_bench.DEFAULT_LOST)
-        survivors = [i for i in range(total) if i not in lost]
-        rows = enc.decode_matrix_rows(survivors, lost)
-        s = (8 if shrink else 16) * MIB
-        host = _make_slabs(4, k, s, seed=55)
-        dev = [jax.device_put(h) for h in host]
-        fn = _make_folded_fn(
-            lambda c, x: rs_pallas.apply_gf_matrix(c, x), rows, 1)
-        t = _time_folded(fn, [(d,) for d in dev], passes=3)
-        n_bytes = 3 * len(dev) * k * s
-        gibps = n_bytes / GIB / t
-        res["repair_decode_device_gibps"] = round(gibps, 3)
-        log(f"config-5 device-resident 4-loss reconstruct: "
-            f"{gibps:.2f} GiB/s")
+            enc = DEFAULT_SCHEME.encoder
+            k, total = enc.data_shards, enc.data_shards + enc.parity_shards
+            lost = list(repair_bench.DEFAULT_LOST)
+            survivors = [i for i in range(total) if i not in lost]
+            rows = enc.decode_matrix_rows(survivors, lost)
+            s = (8 if shrink else 16) * MIB
+            host = _make_slabs(4, k, s, seed=55)
+            dev = [jax.device_put(h) for h in host]
+            fn = _make_folded_fn(
+                lambda c, x: rs_pallas.apply_gf_matrix(c, x), rows, 1)
+            t, _ = _time_folded(fn, [(d,) for d in dev], passes=3)
+            n_bytes = 3 * len(dev) * k * s
+            gibps = n_bytes / GIB / t
+            res["repair_decode_device_gibps"] = round(gibps, 3)
+            log(f"config-5 device-resident 4-loss reconstruct: "
+                f"{gibps:.2f} GiB/s")
+        except Exception as e:  # noqa: BLE001 — secondary metric only
+            log(f"config-5 device-resident reconstruct unavailable: {e}")
         _persist(res)
 
     shard_len = ((4 if shrink else 8) * MIB) if on_acc else (2 * MIB)

@@ -70,18 +70,21 @@ def main() -> int:
         print(f"equality check FAILED {res['device_equal_error']}", flush=True)
     persist()
 
-    def timed(tag: str, s: int, rpb: int, nargs: int = 1) -> None:
+    def timed(tag: str, s: int, rpb: int, nargs: int = 1,
+              cse: bool = True) -> None:
         probe = {"tag": tag, "slab_mib": s / MIB, "rows_per_block": rpb,
-                 "nargs": nargs, "input_mib": nargs * k * s // MIB}
+                 "nargs": nargs, "cse": cse,
+                 "input_mib": nargs * k * s // MIB}
         try:
             fn = _make_folded_fn(
                 lambda c, x: rs_pallas.apply_gf_matrix_swar(
-                    c, x, rows_per_block=rpb), coefs, nargs)
+                    c, x, rows_per_block=rpb, cse=cse), coefs, nargs)
             groups = [tuple(jax.device_put(rng.integers(
                         0, 256, size=(1, k, s), dtype=np.uint8))
                     for _ in range(nargs)) for _ in range(2)]
             passes = 3
-            t = _time_folded(fn, groups, passes)
+            t, warm_s = _time_folded(fn, groups, passes)
+            probe["warm_s"] = round(warm_s, 1)  # compile + first touch
             n_calls = passes * len(groups)
             nbytes = n_calls * nargs * k * s
             probe["calls"] = n_calls
@@ -102,6 +105,7 @@ def main() -> int:
     # per-call overhead from per-byte kernel cost for SWAR.
     timed("A.s4.rpb64", 4 * MIB, 64)
     timed("A.s16.rpb64", 16 * MIB, 64)
+    timed("A.s16.rpb64.nocse", 16 * MIB, 64, cse=False)  # CSE A/B
     timed("A.s16.rpb256", 16 * MIB, 256)
     timed("B.2arg", 16 * MIB, 64, nargs=2)
     timed("B.4arg", 16 * MIB, 64, nargs=4)

@@ -31,9 +31,12 @@ while true; do
       timeout 1500 python scripts/tpu_scaling_probe3.py \
         >> artifacts/scaling_probe3.log 2>&1
       PRC=$?
-      # attempt marker regardless of rc: a hanging probe must burn at
-      # most ONE window, never every window
-      echo "rc=$PRC at $TS" > artifacts/TPU_SCALING_PROBE3.done
+      # Mark done on success or on a timeout kill (a hang must burn at
+      # most ONE window) — but let fast transient failures (tunnel
+      # dropped mid-probe, rc=1) retry on a later window.
+      case "$PRC" in
+        0|124|137) echo "rc=$PRC at $TS" > artifacts/TPU_SCALING_PROBE3.done ;;
+      esac
       echo "$TS probe3 rc=$PRC" >> "$LOG"
     fi
     timeout 2400 python bench.py \

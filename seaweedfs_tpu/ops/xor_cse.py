@@ -1,0 +1,70 @@
+"""Greedy XOR common-subexpression elimination (Paar's algorithm).
+
+The RS encode/reconstruct bit-matrix is a dense GF(2) matrix: output
+plane r = XOR of ~50% of the 8*k input planes. Evaluated row-by-row
+that costs sum(len(row) - 1) XORs (~1200 for RS(10,4)). Many pairs of
+input planes co-occur across rows, so factoring the most frequent pair
+into a fresh virtual plane and substituting it everywhere (repeat until
+no pair repeats) cuts the XOR count roughly in half — fewer vector ops
+per Pallas grid step AND a smaller unrolled program for Mosaic to
+compile.
+
+Reference analog: klauspost/reedsolomon evaluates the matrix with
+per-coefficient PSHUFB table lookups (galois_amd64.s) — table reuse is
+its CSE; in the bitsliced domain the reusable unit is the XOR pair.
+Paar, "Optimized arithmetic for Reed-Solomon encoders" (1997) is the
+published greedy.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from itertools import combinations
+
+
+@lru_cache(maxsize=64)
+def factor(rows: tuple[tuple[int, ...], ...], n_inputs: int):
+    """Factor shared XOR pairs out of ``rows``.
+
+    ``rows[r]`` lists input-plane indices (< n_inputs) to XOR into
+    output r. Returns ``(steps, outs)`` where ``steps`` is a list of
+    ``(new_id, a, b)`` — virtual plane ``new_id`` = plane a ^ plane b,
+    ids assigned from ``n_inputs`` upward, each referring only to
+    earlier ids — and ``outs[r]`` is the (possibly shorter) index list
+    whose XOR equals the original row. Total XOR cost drops from
+    ``sum(len(r) - 1)`` to ``len(steps) + sum(len(out) - 1)``.
+    """
+    work = [set(r) for r in rows]
+    steps: list[tuple[int, int, int]] = []
+    next_id = n_inputs
+    while True:
+        counts: dict[tuple[int, int], int] = {}
+        for row in work:
+            if len(row) < 2:
+                continue
+            for pair in combinations(sorted(row), 2):
+                counts[pair] = counts.get(pair, 0) + 1
+        if not counts:
+            break
+        (a, b), best = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+        if best < 2:
+            break
+        steps.append((next_id, a, b))
+        for row in work:
+            if a in row and b in row:
+                row.discard(a)
+                row.discard(b)
+                row.add(next_id)
+        next_id += 1
+    outs = tuple(tuple(sorted(r)) for r in work)
+    return steps, outs
+
+
+def xor_cost(rows) -> int:
+    """XORs to evaluate rows directly (no factoring)."""
+    return sum(max(0, len(r) - 1) for r in rows)
+
+
+def factored_cost(rows: tuple[tuple[int, ...], ...], n_inputs: int) -> int:
+    steps, outs = factor(tuple(tuple(r) for r in rows), n_inputs)
+    return len(steps) + xor_cost(outs)

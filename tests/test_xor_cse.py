@@ -1,0 +1,62 @@
+"""Paar XOR-CSE factoring: semantics, cost, and edge cases."""
+
+import numpy as np
+
+from seaweedfs_tpu.ops import bitslice, xor_cse
+from seaweedfs_tpu.ops.rs_jax import Encoder
+
+
+def _check_equivalent(rows, n_inputs, seed=0):
+    steps, outs = xor_cse.factor(tuple(tuple(r) for r in rows), n_inputs)
+    rng = np.random.default_rng(seed)
+    vals = list(rng.integers(0, 2**32, n_inputs, dtype=np.uint64))
+    for nid, a, b in steps:
+        assert nid == len(vals)
+        assert a < nid and b < nid
+        vals.append(vals[a] ^ vals[b])
+    for row, out in zip(rows, outs):
+        want = 0
+        for t in row:
+            want ^= vals[t]
+        got = 0
+        for t in out:
+            got ^= vals[t]
+        assert got == want
+    return steps, outs
+
+
+def test_rs_matrix_equivalence_and_reduction():
+    for (k, m) in ((10, 4), (6, 3), (12, 4)):
+        mbits = bitslice.expand_gf2(Encoder(k, m).parity_coefs)
+        rows = [tuple(int(t) for t in np.nonzero(mbits[r])[0])
+                for r in range(8 * m)]
+        _check_equivalent(rows, 8 * k, seed=k)
+        direct = xor_cse.xor_cost(rows)
+        fact = xor_cse.factored_cost(tuple(rows), 8 * k)
+        assert fact < direct * 0.6, (k, m, direct, fact)
+
+
+def test_random_sparse_matrices():
+    rng = np.random.default_rng(42)
+    for density in (0.1, 0.5, 0.9):
+        n_in, n_out = 24, 16
+        rows = [tuple(np.nonzero(rng.random(n_in) < density)[0].tolist())
+                for _ in range(n_out)]
+        _check_equivalent(rows, n_in, seed=int(density * 10))
+
+
+def test_edge_rows():
+    # empty row, single-element row, duplicate rows
+    rows = [(), (3,), (1, 2), (1, 2), (0, 1, 2, 3)]
+    steps, outs = _check_equivalent(rows, 4)
+    assert outs[0] == ()
+    assert outs[1] == (3,)
+    # the duplicated (1,2) pair must have been factored once and shared
+    assert outs[2] == outs[3]
+
+
+def test_no_factorable_pairs_is_identity():
+    rows = [(0, 1), (2, 3)]
+    steps, outs = xor_cse.factor(tuple(rows), 4)
+    assert steps == []
+    assert outs == ((0, 1), (2, 3))
