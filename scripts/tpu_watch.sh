@@ -28,16 +28,23 @@ while true; do
     echo "$TS tpu up; running probe3 then full bench" >> "$LOG"
     touch artifacts/tpu.lock
     if [ ! -f artifacts/TPU_SCALING_PROBE3.done ]; then
-      timeout 1500 python scripts/tpu_scaling_probe3.py \
+      timeout 900 python scripts/tpu_scaling_probe3.py \
         >> artifacts/scaling_probe3.log 2>&1
       PRC=$?
-      # Mark done on success or on a timeout kill (a hang must burn at
-      # most ONE window) — but let fast transient failures (tunnel
-      # dropped mid-probe, rc=1) retry on a later window.
+      # Mark done on success or timeout (a hang burns at most ONE
+      # window); other failures get ONE retry on a later window — a
+      # deterministic non-timeout failure must not burn every window,
+      # and a transient one deserves a second chance.
+      TRIES_FILE=artifacts/TPU_SCALING_PROBE3.tries
+      TRIES=$(( $(cat "$TRIES_FILE" 2>/dev/null || echo 0) + 1 ))
+      echo "$TRIES" > "$TRIES_FILE"
       case "$PRC" in
         0|124|137) echo "rc=$PRC at $TS" > artifacts/TPU_SCALING_PROBE3.done ;;
+        *) [ "$TRIES" -ge 2 ] && \
+             echo "rc=$PRC after $TRIES tries at $TS" \
+               > artifacts/TPU_SCALING_PROBE3.done ;;
       esac
-      echo "$TS probe3 rc=$PRC" >> "$LOG"
+      echo "$TS probe3 rc=$PRC try=$TRIES" >> "$LOG"
     fi
     timeout 2400 python bench.py \
       > "artifacts/BENCH_attempt_$TS.json" \

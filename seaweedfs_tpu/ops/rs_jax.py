@@ -19,6 +19,7 @@ memoized per survivor set, mirroring klauspost's inversion_tree.go cache.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -37,8 +38,19 @@ PALLAS_MIN_S = 256 * 1024
 #: Chunk the pure-XLA path along S above this, bounding the ~12x word
 #: expansion its unfused pack/XOR/unpack intermediates cost in HBM/RAM.
 XLA_CHUNK_S = 4 * 1024 * 1024
-#: Test/debug override: "pallas" | "native" | "xla" | None (auto).
+#: Test/debug override: "pallas" | "pallas_swar" | "native" | "xla" |
+#: None (auto).
 FORCE: Optional[str] = None
+#: Which Pallas kernel the auto "pallas" variant uses: "transpose"
+#: (default — oracle-smoked on hardware every bench round) or "swar"
+#: (transpose-free; see rs_pallas.apply_gf_matrix_swar). Overridable via
+#: the SEAWEEDFS_TPU_KERNEL environment variable so a measured winner
+#: can be promoted without a code change.
+PALLAS_KERNEL = os.environ.get("SEAWEEDFS_TPU_KERNEL", "transpose")
+if PALLAS_KERNEL not in ("transpose", "swar"):
+    raise ValueError(
+        f"SEAWEEDFS_TPU_KERNEL={PALLAS_KERNEL!r}: expected 'transpose' "
+        f"or 'swar'")
 
 
 def _use_pallas() -> bool:
@@ -51,7 +63,7 @@ def _pick_variant(s: int) -> str:
     if FORCE:
         return FORCE
     if _use_pallas() and s >= PALLAS_MIN_S:
-        return "pallas"
+        return "pallas_swar" if PALLAS_KERNEL == "swar" else "pallas"
     if jax.default_backend() == "cpu" and rs_native.available():
         # Measured on this host: the AVX2 nibble-LUT codec beats the
         # XLA:CPU bitslice network ~10x, so it IS the CPU fallback
@@ -70,6 +82,10 @@ def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
         @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
             return rs_pallas.apply_gf_matrix(coefs, x)
+    elif variant == "pallas_swar":
+        @jax.jit
+        def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
+            return rs_pallas.apply_gf_matrix_swar(coefs, x)
     elif variant == "xla":
         @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
@@ -110,6 +126,8 @@ def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
     nc = 1
     if variant == "pallas":
         seg = rs_pallas.SEG_BYTES
+    elif variant == "pallas_swar":
+        seg = rs_pallas.SWAR_SEG_BYTES
     elif variant == "xla" and s > XLA_CHUNK_S:
         variant = "xla_chunked"
         nc = -(-s // XLA_CHUNK_S)

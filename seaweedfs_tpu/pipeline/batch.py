@@ -38,6 +38,13 @@ from .stripe import iter_row_batches, stripe_rows
 DEFAULT_MAX_BATCH_BYTES = 256 * 1024 * 1024
 
 
+def max_rows_per_batch(k: int, block: int, max_batch_bytes: int) -> int:
+    """Row cap at which a (k, block)-shaped bucket flushes — THE flush
+    rule; bench.py's config-3 census classifies full vs tail batches
+    with the same formula, so keep them in lockstep here."""
+    return max(1, max_batch_bytes // max(k * block, 1))
+
+
 @dataclass(frozen=True)
 class RowSpan:
     """``rows[r0:r0+n]`` of a packed batch hold volume ``key``'s shard
@@ -102,8 +109,7 @@ def iter_packed_batches(sources: Iterable[tuple[object, np.ndarray]],
                                        max_batch_bytes):
         shape = (rows.shape[1], rows.shape[2])
         block = shape[1]
-        per_row = shape[0] * block
-        max_rows = max(1, max_batch_bytes // max(per_row, 1))
+        max_rows = max_rows_per_batch(shape[0], block, max_batch_bytes)
         b = buckets.setdefault(shape, _Bucket())
         r = 0
         while r < rows.shape[0]:
