@@ -40,6 +40,28 @@ def _run_scaffold(argv: list[str]) -> int:
     return 0
 
 
+def _run_tls_gen(argv: list[str]) -> int:
+    import argparse
+
+    from .util import tls
+    p = argparse.ArgumentParser(
+        prog="tls.gen",
+        description="self-signed CA + cluster pair for [grpc.tls]")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-hosts", default="localhost",
+                   help="comma-separated DNS SANs")
+    p.add_argument("-ips", default="127.0.0.1",
+                   help="comma-separated IP SANs")
+    args = p.parse_args(argv)
+    paths = tls.generate_cluster_credentials(
+        args.dir,
+        hosts=tuple(h for h in args.hosts.split(",") if h),
+        ips=tuple(i for i in args.ips.split(",") if i))
+    for k in ("ca", "cert", "key"):
+        print(f"{k} = \"{paths[k]}\"")
+    return 0
+
+
 def _run_filer(argv: list[str]) -> int:
     from .cluster.filer_server import main
     return main(argv)
@@ -129,6 +151,7 @@ COMMANDS = {
     "watch": _run_watch,
     "compact": _run_compact,
     "scaffold": _run_scaffold,
+    "tls.gen": _run_tls_gen,
 }
 
 

@@ -21,6 +21,7 @@ from typing import Iterator, Optional
 from .. import pb
 from ..pb import filer_pb2
 from .master import _grpc_port
+from ..util import tls as tls_mod
 
 
 class FilerClientError(RuntimeError):
@@ -40,7 +41,7 @@ class FilerClient:
         with self._lock:
             if self._channel is None:
                 ip, http_port = self.filer_url.rsplit(":", 1)
-                self._channel = grpc.insecure_channel(
+                self._channel = tls_mod.dial(
                     f"{ip}:{_grpc_port(int(http_port))}")
             return pb.filer_stub(self._channel)
 

@@ -28,6 +28,7 @@ from ..util import glog
 from ..util.stats import Metrics
 from .master import _grpc_port
 from .wdclient import MasterClient
+from ..util import tls as tls_mod
 
 
 class FilerServer:
@@ -56,8 +57,8 @@ class FilerServer:
             futures.ThreadPoolExecutor(max_workers=16))
         self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
             pb.FILER_SERVICE, pb.FILER_METHODS, _FilerServicer(self)),))
-        bound = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{_grpc_port(self.port)}")
+        bound = tls_mod.serve_port(
+            self._grpc_server, f"{self.ip}:{_grpc_port(self.port)}")
         if bound == 0:
             raise RuntimeError(
                 f"cannot bind filer grpc port {_grpc_port(self.port)}")
@@ -422,7 +423,12 @@ def main(argv: list[str]) -> int:
                    help="append metadata events to this JSON-lines file")
     p.add_argument("-notify.webhook", dest="notify_webhook", default="",
                    help="POST metadata events to this URL")
+    p.add_argument("-config", default="",
+                   help="security.toml (jwt signing key, [grpc.tls])")
     args = p.parse_args(argv)
+    from ..util import config as config_mod
+    tls_mod.install_from_config(
+        config_mod.load(args.config) if args.config else {})
     store = SqliteStore(args.db) if args.db else MemoryStore()
     filer = Filer(store)
     server = FilerServer(filer, ip=args.ip, port=args.port,

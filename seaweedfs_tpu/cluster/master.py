@@ -32,6 +32,7 @@ from ..storage.types import FileId
 from ..util import config as config_mod
 from ..util import glog
 from ..util import security
+from ..util import tls as tls_mod
 from ..util.stats import Metrics
 from . import ha as ha_mod
 from .ha import NotLeaderError
@@ -136,8 +137,8 @@ class MasterServer:
             futures.ThreadPoolExecutor(max_workers=16))
         self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
             pb.MASTER_SERVICE, pb.MASTER_METHODS, _MasterServicer(self)),))
-        bound = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{_grpc_port(self.port)}")
+        bound = tls_mod.serve_port(
+            self._grpc_server, f"{self.ip}:{_grpc_port(self.port)}")
         if bound == 0:
             raise RuntimeError(
                 f"cannot bind master grpc port {_grpc_port(self.port)}")
@@ -330,7 +331,7 @@ class MasterServer:
         if ch is None:
             ip, http_port = node_url.rsplit(":", 1)
             ch = security.grpc_auth_channel(
-                grpc.insecure_channel(
+                tls_mod.dial(
                     f"{ip}:{_grpc_port(int(http_port))}"), self.guard)
             self._channels[node_url] = ch
         return pb.volume_stub(ch)
@@ -678,6 +679,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = p.parse_args(argv)
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
+    tls_mod.install_from_config(conf)
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,
                       default_replication=args.defaultReplication,

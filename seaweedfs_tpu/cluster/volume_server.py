@@ -40,6 +40,7 @@ from ..storage.volume import dat_path, idx_path
 from ..util import glog, security
 from ..util.stats import Metrics
 from .master import _grpc_port
+from ..util import tls as tls_mod
 
 _COPY_CHUNK = 1024 * 1024
 
@@ -127,8 +128,8 @@ class VolumeServer:
             interceptors=(auth,) if auth else ())
         self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
             pb.VOLUME_SERVICE, pb.VOLUME_METHODS, _VolumeServicer(self)),))
-        bound = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{_grpc_port(self.port)}")
+        bound = tls_mod.serve_port(
+            self._grpc_server, f"{self.ip}:{_grpc_port(self.port)}")
         if bound == 0:
             raise RuntimeError(
                 f"cannot bind volume grpc port {_grpc_port(self.port)}")
@@ -181,7 +182,7 @@ class VolumeServer:
             ch = self._channels.get(url)
             if ch is None:
                 ip, http_port = url.rsplit(":", 1)
-                ch = security.grpc_auth_channel(grpc.insecure_channel(
+                ch = security.grpc_auth_channel(tls_mod.dial(
                     f"{ip}:{_grpc_port(int(http_port))}"), self.guard)
                 self._channels[url] = ch
             return ch
@@ -971,6 +972,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     from ..util import config as config_mod
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
+    tls_mod.install_from_config(conf)
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,
                   needle_map=args.index)
     store.load_existing()

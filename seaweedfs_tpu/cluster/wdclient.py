@@ -15,6 +15,7 @@ from typing import Optional
 from .. import pb
 from ..pb import master_pb2
 from .master import _grpc_port
+from ..util import tls as tls_mod
 
 _LEADER_RE = re.compile(r"leader is ([0-9A-Za-z_.-]+:\d+)")
 
@@ -38,7 +39,7 @@ class MasterClient:
         with self._lock:
             if self._channel is None:
                 ip, http_port = self.master_url.rsplit(":", 1)
-                self._channel = grpc.insecure_channel(
+                self._channel = tls_mod.dial(
                     f"{ip}:{_grpc_port(int(http_port))}")
             return pb.master_stub(self._channel)
 

@@ -21,6 +21,7 @@ from ..cluster.master import _grpc_port
 from ..pb import filer_pb2
 from ..util import glog
 from .sinks import ReplicationSink
+from ..util import tls as tls_mod
 
 
 class Replicator:
@@ -67,7 +68,7 @@ class Replicator:
 
         if self._channel is None:
             ip, http_port = self.source_url.rsplit(":", 1)
-            self._channel = grpc.insecure_channel(
+            self._channel = tls_mod.dial(
                 f"{ip}:{_grpc_port(int(http_port))}")
         return pb.filer_stub(self._channel)
 
@@ -175,7 +176,12 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="replicate only this subtree")
     p.add_argument("-noBootstrap", action="store_true",
                    help="skip the initial full-tree sync")
+    p.add_argument("-config", default="",
+                   help="security.toml ([grpc.tls] client credentials)")
     args = p.parse_args(argv)
+    from ..util import config as config_mod
+    tls_mod.install_from_config(
+        config_mod.load(args.config) if args.config else {})
     rep = Replicator(args.src, FilerSink(args.src, args.dst),
                      path_prefix=args.path,
                      bootstrap=not args.noBootstrap).start()

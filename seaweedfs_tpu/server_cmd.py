@@ -14,6 +14,7 @@ import time
 from typing import Optional
 
 from .util import config as config_mod
+from .util import tls as tls_mod
 from .util import glog
 
 
@@ -49,6 +50,7 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
+    tls_mod.install_from_config(conf)
 
     from .cluster.master import MasterServer
     from .cluster.volume_server import VolumeServer

@@ -62,8 +62,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.master:
         from . import fs_commands  # noqa: F401 — registers fs.* commands
         from ..util import config as config_mod
+        from ..util import tls as tls_mod
         conf = config_mod.load(args.config) if args.config else {}
         secret = config_mod.lookup(conf, "jwt.signing.key", "")
+        tls_mod.install_from_config(conf)
         env = ClusterEnv(master_url=args.master, filer_url=args.filer,
                          secret=secret)
         run = run_cluster_command

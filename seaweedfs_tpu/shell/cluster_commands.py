@@ -78,11 +78,12 @@ class ClusterEnv:
         import grpc
 
         from ..util import security
+        from ..util import tls as tls_mod
 
         ch = self._channels.get(url)
         if ch is None:
             ip, port = url.rsplit(":", 1)
-            ch = grpc.insecure_channel(f"{ip}:{int(port) + grpc_offset}")
+            ch = tls_mod.dial(f"{ip}:{int(port) + grpc_offset}")
             if self.secret:
                 ch = security.grpc_auth_channel(
                     ch, security.Guard(self.secret))

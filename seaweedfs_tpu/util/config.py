@@ -18,6 +18,15 @@ SCAFFOLDS = {
 [jwt.signing]
 key = ""            # non-empty enables write JWT verification
 expires_after_seconds = 10
+
+# Mutual TLS for the gRPC plane (admin RPCs, EC shard reads). Generate a
+# localhost CA + cluster pair with:  python -m seaweedfs_tpu tls.gen -dir certs
+# All three paths set -> every gRPC server requires client certs and
+# every channel dials with this CA + pair.
+[grpc.tls]
+ca = ""             # e.g. certs/ca.crt
+cert = ""           # e.g. certs/cluster.crt
+key = ""            # e.g. certs/cluster.key
 """,
     "master": """\
 # master.toml

@@ -20,6 +20,7 @@ from .storage.superblock import SuperBlock
 from .storage.types import NEEDLE_HEADER_SIZE, NEEDLE_PADDING_SIZE, \
     to_offset_units
 from .storage.volume import dat_path, idx_path
+from .util import tls as tls_mod
 
 
 def walk_dat_records(base: str | Path):
@@ -183,9 +184,14 @@ def run_watch(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="watch")
     p.add_argument("-filer", required=True)
     p.add_argument("-pathPrefix", default="/")
+    p.add_argument("-config", default="",
+                   help="security.toml ([grpc.tls] client credentials)")
     args = p.parse_args(argv)
+    from .util import config as config_mod
+    tls_mod.install_from_config(
+        config_mod.load(args.config) if args.config else {})
     ip, http_port = args.filer.rsplit(":", 1)
-    ch = grpc.insecure_channel(f"{ip}:{_grpc_port(int(http_port))}")
+    ch = tls_mod.dial(f"{ip}:{_grpc_port(int(http_port))}")
     stub = pb.filer_stub(ch)
     stream = stub.SubscribeMetadata(filer_pb2.SubscribeMetadataRequest(
         client_name="weed-watch", path_prefix=args.pathPrefix))

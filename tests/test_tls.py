@@ -1,0 +1,114 @@
+"""Mutual TLS on the gRPC plane (util/tls.py).
+
+Reference analog: weed/security's security.toml gRPC TLS (SURVEY.md §2
+Security row). A master + volume pair runs with mTLS installed; the
+shard plane works end-to-end, and a client WITHOUT the cluster
+credentials is rejected at the transport layer."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.master import MasterServer, _grpc_port
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.cluster import operation
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.util import tls as tls_mod
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture()
+def tls_cluster(tmp_path):
+    paths = tls_mod.generate_cluster_credentials(tmp_path / "certs")
+    tls_mod.install(tls_mod.TlsConfig.from_files(
+        paths["ca"], paths["cert"], paths["key"]))
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=7).start()
+    (tmp_path / "vol").mkdir()
+    store = Store([tmp_path / "vol"], max_volumes=8)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url,
+                      pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    assert master.topology.nodes, "volume server never heartbeat in"
+    try:
+        yield master, vs
+    finally:
+        vs.stop()
+        master.stop()
+        tls_mod.install(None)
+
+
+def test_config_roundtrip(tmp_path):
+    paths = tls_mod.generate_cluster_credentials(tmp_path)
+    cfg = tls_mod.TlsConfig.from_files(paths["ca"], paths["cert"],
+                                       paths["key"])
+    assert b"BEGIN CERTIFICATE" in cfg.ca_cert
+    assert b"BEGIN CERTIFICATE" in cfg.cert
+    assert b"PRIVATE KEY" in cfg.key
+    # install_from_config wiring (security.toml [grpc.tls] shape)
+    conf = {"grpc": {"tls": {"ca": paths["ca"], "cert": paths["cert"],
+                             "key": paths["key"]}}}
+    assert tls_mod.install_from_config(conf)
+    assert tls_mod.installed() is not None
+    assert not tls_mod.install_from_config({})
+    assert tls_mod.installed() is None
+
+
+def test_mtls_cluster_write_read(tls_cluster):
+    master, vs = tls_cluster
+    from seaweedfs_tpu.cluster.wdclient import MasterClient
+
+    # write + read a file through the normal path; the heartbeat stream
+    # and every internal gRPC channel ride the secured transport
+    mc = MasterClient(master.url)
+    a = operation.assign(mc)
+    operation.upload(a.url, a.fid, b"tls-payload", jwt=a.auth)
+    assert operation.download(mc, a.fid) == b"tls-payload"
+    mc.close()
+
+
+def test_client_without_certs_rejected(tls_cluster):
+    master, vs = tls_cluster
+    import grpc
+
+    from seaweedfs_tpu import pb
+
+    # plaintext dial: must fail at transport, never reach the servicer
+    ch = grpc.insecure_channel(f"127.0.0.1:{_grpc_port(vs.port)}")
+    stub = pb.volume_stub(ch)
+    req = pb.volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=1)
+    with pytest.raises(grpc.RpcError):
+        stub.VolumeMarkReadonly(req, timeout=3)
+    ch.close()
+
+    # TLS dial with a DIFFERENT CA/pair: handshake must be refused
+    other = tls_mod.generate_cluster_credentials(
+        vs.store.locations[0].directory / "other-certs")
+    creds = tls_mod.TlsConfig.from_files(
+        other["ca"], other["cert"], other["key"]).channel_credentials()
+    ch2 = grpc.secure_channel(f"127.0.0.1:{_grpc_port(vs.port)}", creds)
+    stub2 = pb.volume_stub(ch2)
+    with pytest.raises(grpc.RpcError):
+        stub2.VolumeMarkReadonly(req, timeout=3)
+    ch2.close()
