@@ -21,6 +21,7 @@ import numpy as np
 
 from .cluster import operation
 from .cluster.wdclient import MasterClient
+from .util import tls as tls_mod
 
 
 def run_upload(argv: list[str]) -> int:
@@ -30,7 +31,9 @@ def run_upload(argv: list[str]) -> int:
     p.add_argument("-replication", default="")
     p.add_argument("-ttl", default="")
     p.add_argument("files", nargs="+")
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
     master = MasterClient(args.master)
     results = []
     for f in args.files:
@@ -52,7 +55,9 @@ def run_download(argv: list[str]) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-dir", default=".")
     p.add_argument("fids", nargs="+")
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
     master = MasterClient(args.master)
     for fid in args.fids:
         data = operation.download(master, fid,
@@ -69,7 +74,9 @@ def run_delete(argv: list[str]) -> int:
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-collection", default="")
     p.add_argument("fids", nargs="+")
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
     master = MasterClient(args.master)
     for fid in args.fids:
         operation.delete(master, fid, collection=args.collection)
@@ -97,7 +104,9 @@ def run_benchmark(argv: list[str]) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-write-only", action="store_true")
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
     master = MasterClient(args.master)
     rng = np.random.default_rng(0)
     payloads = [rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()

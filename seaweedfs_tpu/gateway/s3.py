@@ -110,13 +110,19 @@ class S3Gateway:
 
     # ---- bucket ops ----
 
-    def list_buckets(self) -> bytes:
+    def list_buckets(self, ident=None) -> bytes:
         root = ET.Element("ListAllMyBucketsResult", xmlns=XMLNS)
         owner = ET.SubElement(root, "Owner")
         ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
         buckets = ET.SubElement(root, "Buckets")
         for e in self.filer.list(BUCKETS_DIR):
             if not e.is_directory or e.name == UPLOADS_DIR:
+                continue
+            if ident is not None and not (
+                    ident.can("Read", e.name) or
+                    ident.can("Write", e.name)):
+                # scoped identities see only buckets they can touch
+                # (weed s3api filters the listing the same way)
                 continue
             b = ET.SubElement(buckets, "Bucket")
             ET.SubElement(b, "Name").text = e.name
@@ -299,22 +305,32 @@ class S3Gateway:
 
     def upload_part(self, bucket: str, key: str, upload_id: str,
                     part_number: int, data: bytes) -> str:
-        self._upload_dir(upload_id)
+        self._upload_dir(upload_id, bucket)
         self.filer.put_data(
             f"{BUCKETS_DIR}/{UPLOADS_DIR}/{upload_id}/"
             f"{part_number:05d}.part", data)
         return hashlib.md5(data).hexdigest()
 
-    def _upload_dir(self, upload_id: str) -> str:
+    def _upload_dir(self, upload_id: str,
+                    bucket: Optional[str] = None) -> str:
         d = f"{BUCKETS_DIR}/{UPLOADS_DIR}/{upload_id}"
         if self.filer.lookup(f"{BUCKETS_DIR}/{UPLOADS_DIR}",
                              upload_id) is None:
             raise S3Error("NoSuchUpload", upload_id)
+        if bucket is not None:
+            # the URL bucket was what the caller was AUTHORIZED against;
+            # it must be the bucket the upload was initiated in, or a
+            # scoped identity could drive another bucket's upload
+            marker = self.filer.lookup(d, "key")
+            owner = (marker.extended.get("bucket", b"").decode()
+                     if marker is not None else "")
+            if owner and owner != bucket:
+                raise S3Error("NoSuchUpload", upload_id)
         return d
 
     def complete_multipart(self, bucket: str, key: str,
                            upload_id: str) -> bytes:
-        d = self._upload_dir(upload_id)
+        d = self._upload_dir(upload_id, bucket)
         parts = sorted(
             (e for e in self.filer.list(d)
              if e.name.endswith(".part")), key=lambda e: e.name)
@@ -348,8 +364,9 @@ class S3Gateway:
             f'{len(parts)}"'
         return _xml(root)
 
-    def abort_multipart(self, upload_id: str) -> None:
-        self._upload_dir(upload_id)
+    def abort_multipart(self, upload_id: str,
+                        bucket: Optional[str] = None) -> None:
+        self._upload_dir(upload_id, bucket)
         self.filer.delete(f"{BUCKETS_DIR}/{UPLOADS_DIR}", upload_id,
                           recursive=True, delete_data=True)
 
@@ -443,11 +460,21 @@ def _make_handler(gw: S3Gateway):
             self._send(_STATUS.get(code, 500),
                        _error_xml(code, msg, self.path))
 
-        def _auth(self, body: bytes) -> None:
+        def _auth(self, body: bytes, action: str = "",
+                  bucket: str = ""):
             u = urllib.parse.urlsplit(self.path)
-            gw.auth.verify(self.command, u.path or "/", u.query,
-                           self.headers,
-                           hashlib.sha256(body).hexdigest())
+            ident = gw.auth.verify(self.command, u.path or "/", u.query,
+                                   self.headers,
+                                   hashlib.sha256(body).hexdigest())
+            # authorization (weed s3.configure identity actions): None
+            # identity = open gateway, all actions permitted
+            if ident is not None and action and \
+                    not ident.can(action, bucket):
+                raise AuthError(
+                    "AccessDenied",
+                    f"{action} on {bucket or 'service'} not permitted "
+                    f"for {ident.name}")
+            return ident
 
         # -- verbs --
 
@@ -455,9 +482,9 @@ def _make_handler(gw: S3Gateway):
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="GET").inc()
             try:
-                self._auth(b"")
+                ident = self._auth(b"", "Read" if bucket else "", bucket)
                 if not bucket:
-                    self._send(200, gw.list_buckets())
+                    self._send(200, gw.list_buckets(ident))
                 elif not key:
                     v2 = q.get("list-type") == "2"
                     self._send(200, gw.list_objects(bucket, q, v2))
@@ -488,7 +515,7 @@ def _make_handler(gw: S3Gateway):
         def do_HEAD(self):
             bucket, key, q, _ = self._split()
             try:
-                self._auth(b"")
+                self._auth(b"", "Read", bucket)
                 if not key:
                     gw._require_bucket(bucket)
                     self._send(200)
@@ -508,7 +535,8 @@ def _make_handler(gw: S3Gateway):
             gw.metrics.counter("request_total", method="PUT").inc()
             body = self._body()
             try:
-                self._auth(body)
+                ident = self._auth(body, "Write" if key else "Admin",
+                                   bucket)
                 if not key:
                     gw.create_bucket(bucket)
                     self._send(200)
@@ -520,6 +548,13 @@ def _make_handler(gw: S3Gateway):
                     src = urllib.parse.unquote(
                         self.headers["x-amz-copy-source"]).lstrip("/")
                     sb, _, sk = src.partition("/")
+                    # copying also READS the source bucket (identity is
+                    # already authenticated; just authorize)
+                    if ident is not None and not ident.can("Read", sb):
+                        raise AuthError(
+                            "AccessDenied",
+                            f"Read on {sb} not permitted for "
+                            f"{ident.name}")
                     self._send(200, gw.copy_object(bucket, key, sb, sk))
                 else:
                     etag = gw.put_object(
@@ -533,7 +568,7 @@ def _make_handler(gw: S3Gateway):
             bucket, key, q, _ = self._split()
             body = self._body()
             try:
-                self._auth(body)
+                self._auth(body, "Write", bucket)
                 if "uploads" in q:
                     self._send(200, gw.initiate_multipart(bucket, key))
                 elif "uploadId" in q:
@@ -549,9 +584,9 @@ def _make_handler(gw: S3Gateway):
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="DELETE").inc()
             try:
-                self._auth(b"")
+                self._auth(b"", "Write" if key else "Admin", bucket)
                 if "uploadId" in q:
-                    gw.abort_multipart(q["uploadId"])
+                    gw.abort_multipart(q["uploadId"], bucket)
                     self._send(204)
                 elif not key:
                     gw.delete_bucket(bucket)
@@ -594,7 +629,10 @@ def main(argv: list[str]) -> int:
     p.add_argument("-filer", default="127.0.0.1:8888")
     p.add_argument("-config", default="",
                    help="identities JSON (empty = open access)")
+    from ..util import tls as tls_mod
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
     idents = load_identities(args.config) if args.config else None
     gw = S3Gateway(args.filer, ip=args.ip, port=args.port,
                    identities=idents).start()

@@ -23,7 +23,23 @@ class Identity:
     name: str
     access_key: str
     secret_key: str
-    actions: tuple[str, ...] = ("Admin",)  # Admin|Read|Write
+    #: Granted actions, weed ``s3.configure`` shape: "Admin" | "Read" |
+    #: "Write", each optionally bucket-scoped as "Action:bucket".
+    actions: tuple[str, ...] = ("Admin",)
+
+    def can(self, action: str, bucket: str = "") -> bool:
+        """Authorize ``action`` ("Read"/"Write"/"Admin") on ``bucket``.
+
+        Mirrors weed/s3api identity actions: "Admin" covers everything;
+        a bare action grants it on every bucket; "Action:bucket" scopes
+        the grant to one bucket (and never satisfies bucket-less ops)."""
+        for a in self.actions:
+            name, _, scope = a.partition(":")
+            if scope and (not bucket or scope != bucket):
+                continue
+            if name == "Admin" or name == action:
+                return True
+        return False
 
 
 class AuthError(Exception):

@@ -269,7 +269,10 @@ def main(argv: list[str]) -> int:
     p.add_argument("-filer", default="127.0.0.1:8888")
     p.add_argument("-root", default="/",
                    help="filer directory served as the DAV root")
+    from ..util import tls as tls_mod
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
     srv = WebDavServer(args.filer, ip=args.ip, port=args.port,
                        root=args.root).start()
     stop = threading.Event()

@@ -25,7 +25,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-debug", action="store_true")
+    from ..util import tls as tls_mod
+    tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
+    tls_mod.install_from_flag(args)
 
     from . import fuse_ll
     from .wfs import WFS

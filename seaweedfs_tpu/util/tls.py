@@ -94,6 +94,22 @@ def install_from_config(conf: dict) -> bool:
     return False
 
 
+def add_security_flag(parser) -> None:
+    """Attach the standard ``-securityConfig`` flag (security.toml path)
+    to a client-tool argparser."""
+    parser.add_argument(
+        "-securityConfig", default="",
+        help="security.toml ([grpc.tls] client credentials)")
+
+
+def install_from_flag(args) -> None:
+    """Install TLS from an argparse namespace carrying
+    ``-securityConfig`` (no-op when the flag is empty)."""
+    from . import config as config_mod
+    path = getattr(args, "securityConfig", "")
+    install_from_config(config_mod.load(path) if path else {})
+
+
 def dial(target: str, options=None):
     """Open a gRPC channel honoring the installed TLS config."""
     import grpc

@@ -305,3 +305,86 @@ def test_self_copy_is_safe(s3):
               headers={"x-amz-copy-source": "/selfbkt/o.bin"}) as r:
         assert r.status == 200
     assert _req(s3, "GET", "/selfbkt/o.bin").read() == payload
+
+
+def test_identity_action_authorization(tmp_path_factory):
+    """weed s3.configure-style actions: Read/Write/Admin, optionally
+    bucket-scoped — an authenticated identity without the grant gets
+    AccessDenied (403)."""
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=29).start()
+    store = Store([tmp_path_factory.mktemp("actvol")], max_volumes=4)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    idents = [
+        Identity(name="boss", access_key="ADMIN", secret_key="S1"),
+        Identity(name="reader", access_key="RO", secret_key="S2",
+                 actions=("Read",)),
+        Identity(name="scoped", access_key="SCOPED", secret_key="S3",
+                 actions=("Write:only",)),
+    ]
+    gw = S3Gateway(filer.url, port=_free_port_pair(),
+                   identities=idents).start()
+
+    def signed(method, path, body=b"", ak="ADMIN", sk="S1"):
+        url = f"http://{gw.url}{path}"
+        hdrs = sign_request_headers(method, url, {}, body, ak, sk)
+        req = urllib.request.Request(url, data=body or None,
+                                     method=method, headers=hdrs)
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        # admin sets the stage
+        assert signed("PUT", "/only").status == 200
+        assert signed("PUT", "/other").status == 200
+        assert signed("PUT", "/only/o.txt", b"x").status == 200
+
+        # read-only identity: GET ok, PUT denied
+        assert signed("GET", "/only/o.txt", ak="RO",
+                      sk="S2").read() == b"x"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            signed("PUT", "/only/no.txt", b"y", ak="RO", sk="S2")
+        assert ei.value.code == 403
+        # bucket create needs Admin
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            signed("PUT", "/newbkt", ak="RO", sk="S2")
+        assert ei.value.code == 403
+
+        # scoped writer: write inside its bucket only; no read grant
+        assert signed("PUT", "/only/s.txt", b"z", ak="SCOPED",
+                      sk="S3").status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            signed("PUT", "/other/s.txt", b"z", ak="SCOPED", sk="S3")
+        assert ei.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            signed("GET", "/only/o.txt", ak="SCOPED", sk="S3")
+        assert ei.value.code == 403
+
+        # copy requires Read on the SOURCE bucket too
+        assert signed("PUT", "/other/o2.txt", b"w").status == 200
+
+        def copy(dst, src, ak, sk):
+            url = f"http://{gw.url}{dst}"
+            hdrs = sign_request_headers("PUT", url, {}, b"", ak, sk)
+            hdrs["x-amz-copy-source"] = src
+            req = urllib.request.Request(url, method="PUT",
+                                         headers=hdrs)
+            return urllib.request.urlopen(req, timeout=30)
+
+        # control: admin copies fine through the same request shape,
+        # so a 403 below is the source-Read denial, not a sig artifact
+        assert copy("/only/ok.txt", "/other/o2.txt",
+                    "ADMIN", "S1").status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            copy("/only/copied.txt", "/other/o2.txt", "SCOPED", "S3")
+        assert ei.value.code == 403
+    finally:
+        gw.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
