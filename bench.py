@@ -488,9 +488,15 @@ def child_core() -> None:
         candidates = [("transpose", gf_apply, 2), ("gate", None, 0),
                       ("swar8", _swar64, 2)]
     else:
+        # nargs=8 = 1.25 GiB per dispatch (8 x 160 MiB args): the widest
+        # amortization of the ~8 ms dispatch floor that still respects
+        # the per-buffer compile ceiling. Raced after the safe n4/n1
+        # candidates have banked a headline.
         candidates = [("transpose", gf_apply, 4), ("transpose", gf_apply, 1),
                       ("gate", None, 0),
-                      ("swar64", _swar64, 4), ("swar512", _swar512, 4)]
+                      ("swar64", _swar64, 4),
+                      ("transpose", gf_apply, 8), ("swar64", _swar64, 8),
+                      ("swar512", _swar512, 4)]
 
     compute_gibps = 0.0
     best_name = None
