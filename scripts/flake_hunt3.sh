@@ -1,0 +1,22 @@
+#!/bin/bash
+# Serial full-suite repetitions hunting the once-seen test_mount flake
+# (ROUND4.md "Test-suite health"). Pauses while artifacts/tpu.lock is
+# held so suite CPU load never distorts a benchmark window on this
+# single-core host. Failures land in artifacts/flake3_fail_<n>.log with
+# full tracebacks.
+set -u
+cd /root/repo || exit 1
+N=${1:-20}
+LOG=artifacts/flake_hunt3.log
+for i in $(seq 1 "$N"); do
+  while [ -f artifacts/tpu.lock ]; do sleep 60; done
+  T0=$(date +%s)
+  if python -m pytest tests/ -q -rf --tb=long \
+       > "artifacts/flake3_run.log" 2>&1; then
+    echo "$(date +%s) run $i PASS ($(( $(date +%s) - T0 ))s)" >> "$LOG"
+  else
+    cp artifacts/flake3_run.log "artifacts/flake3_fail_$i.log"
+    echo "$(date +%s) run $i FAIL -> flake3_fail_$i.log" >> "$LOG"
+  fi
+done
+echo "$(date +%s) done ($N runs)" >> "$LOG"

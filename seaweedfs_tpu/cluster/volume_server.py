@@ -960,9 +960,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("-publicUrl", default="")
     p.add_argument("-pulseSeconds", type=float, default=5.0)
     p.add_argument("-index", default="memory",
-                   choices=["memory", "sqlite"],
-                   help="needle map kind (sqlite = disk-backed, for "
-                        "volumes whose index exceeds RAM)")
+                   choices=["memory", "native", "sqlite"],
+                   help="needle map kind: memory (dict), native (C++ "
+                        "open-addressing table, ~10x less RAM), sqlite "
+                        "(disk-backed, index exceeds RAM)")
     p.add_argument("-backend", default="disk",
                    choices=["disk", "mmap"],
                    help=".dat storage backend")

@@ -108,7 +108,8 @@ class Store:
             raise StoreError("a store needs at least one disk location")
         self.locations = [DiskLocation(d, max_volumes) for d in locations]
         #: .dat backend kind (storage/backend.py registry) and needle
-        #: map kind ("memory" | "sqlite") applied to every volume.
+        #: map kind ("memory" | "native" | "sqlite") applied to every
+        #: volume.
         self.backend = backend
         self.needle_map = needle_map
         self.volumes: dict[tuple[str, int], Volume] = {}

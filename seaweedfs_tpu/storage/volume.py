@@ -97,8 +97,25 @@ class Volume:
         self.nm = self._new_needle_map()
         return self
 
+    def _use_native_map(self) -> bool:
+        """native kind requested AND the C library builds here; else
+        warn once and fall back to the memory map."""
+        from ..util import glog
+        from . import needle_map_native
+        if needle_map_native.available():
+            return True
+        glog.warning("native needle map unavailable (no g++?); "
+                     "volume %s falls back to the memory map",
+                     self.volume_id)
+        return False
+
     def _new_needle_map(self):
         if self.needle_map_kind == "memory":
+            return CompactMap()
+        if self.needle_map_kind == "native":
+            if self._use_native_map():
+                from .needle_map_native import NativeNeedleMap
+                return NativeNeedleMap()
             return CompactMap()
         if self.needle_map_kind == "sqlite":
             from .needle_map_sqlite import SqliteNeedleMap
@@ -111,6 +128,11 @@ class Volume:
     def _load_needle_map(self):
         ip = idx_path(self.base)
         if self.needle_map_kind == "memory":
+            return CompactMap.load_from_idx(ip)
+        if self.needle_map_kind == "native":
+            if self._use_native_map():
+                from .needle_map_native import NativeNeedleMap
+                return NativeNeedleMap.load_from_idx(ip)
             return CompactMap.load_from_idx(ip)
         from .needle_map_sqlite import SqliteNeedleMap
         return SqliteNeedleMap.load_from_idx(
