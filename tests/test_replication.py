@@ -33,7 +33,10 @@ def _free_port_pair():
     raise RuntimeError("no free port pair")
 
 
-def _wait_for(pred, timeout=15.0, what="condition"):
+def _wait_for(pred, timeout=45.0, what="condition"):
+    # 45 s: events cannot be LOST (since_ns replay), only late — and on
+    # this single-core host a concurrent heavy process (flake-hunt run 4
+    # overlapping a full suite) starved the 15 s ceiling into a flake.
     deadline = time.time() + timeout
     while time.time() < deadline:
         v = pred()
