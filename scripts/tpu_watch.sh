@@ -25,8 +25,12 @@ while true; do
   echo "$(date +%s) probe rc=$RC platform=$PLATFORM" >> "$LOG"
   if [ "$RC" = "0" ] && [ -n "$PLATFORM" ] && [ "$PLATFORM" != "cpu" ]; then
     TS=$(date +%s)
-    echo "$TS tpu up; running probe3 then full bench" >> "$LOG"
+    echo "$TS tpu up; running full bench then probe3" >> "$LOG"
     touch artifacts/tpu.lock
+    timeout 2400 python bench.py \
+      > "artifacts/BENCH_attempt_$TS.json" \
+      2> "artifacts/BENCH_attempt_$TS.log"
+    BRC=$?
     if [ ! -f artifacts/TPU_SCALING_PROBE3.done ]; then
       timeout 900 python scripts/tpu_scaling_probe3.py \
         >> artifacts/scaling_probe3.log 2>&1
@@ -46,10 +50,6 @@ while true; do
       esac
       echo "$TS probe3 rc=$PRC try=$TRIES" >> "$LOG"
     fi
-    timeout 2400 python bench.py \
-      > "artifacts/BENCH_attempt_$TS.json" \
-      2> "artifacts/BENCH_attempt_$TS.log"
-    BRC=$?
     rm -f artifacts/tpu.lock
     echo "$TS bench rc=$BRC: $(cat artifacts/BENCH_attempt_$TS.json)" >> "$LOG"
     if grep -q '"degraded": false' "artifacts/BENCH_attempt_$TS.json"; then
