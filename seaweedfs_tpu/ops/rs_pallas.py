@@ -182,6 +182,51 @@ def swar_conforms(s: int, rows_per_block: int = SWAR_ROWS) -> bool:
     return s > 0 and s % (4 * rows_per_block * LANES) == 0
 
 
+def _expand_rows(coefs: np.ndarray, n_out: int):
+    mbits = bitslice.expand_gf2(np.asarray(coefs, dtype=np.uint8))
+    return tuple(tuple(int(t) for t in np.nonzero(mbits[rr])[0])
+                 for rr in range(8 * n_out))
+
+
+def apply_gf_matrix_swar_words(coefs: np.ndarray, x4: jnp.ndarray,
+                               interpret: bool = False,
+                               rows_per_block: int = SWAR_ROWS,
+                               cse: bool = True) -> jnp.ndarray:
+    """SWAR kernel on the WORD form: x4 (B, n_in, R, 128) u32 ->
+    (B, n_out, R, 128) u32.
+
+    This is the zero-relayout entry point: a profiler trace of the
+    u8-API path showed the Pallas kernel itself at ~6.5 ms per 160 MiB
+    call (~24 GiB/s) with ~10x that spent in XLA copy/reshape/broadcast
+    ops materializing the (B, n, R, 128) u32 view of a (B, n, S) u8
+    array. The word form IS the array's natural tiled layout — host
+    callers produce it with a free contiguous reshape (np view) and
+    device_put lands it tiled, so nothing is shuffled on device."""
+    n_out, n_in = coefs.shape
+    if x4.ndim != 4 or x4.shape[1] != n_in or x4.shape[3] != LANES:
+        raise ValueError(
+            f"x4 must be (B, {n_in}, R, {LANES}) u32, got {x4.shape}")
+    b, _, r, _ = x4.shape
+    if r % rows_per_block:
+        raise ValueError(f"R={r} must divide by {rows_per_block}")
+    rows = _expand_rows(coefs, n_out)
+    return pl.pallas_call(
+        _make_swar_kernel(rows, n_in, n_out, cse=cse),
+        grid=(b, r // rows_per_block),
+        in_specs=[pl.BlockSpec(
+            (1, n_in, rows_per_block, LANES),
+            lambda bi, ri: (bi, 0, ri, 0),
+            memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(
+            (1, n_out, rows_per_block, LANES),
+            lambda bi, ri: (bi, 0, ri, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (b, n_out, r, LANES), jnp.uint32),
+        interpret=interpret,
+    )(x4)
+
+
 def apply_gf_matrix_swar(coefs: np.ndarray, x: jnp.ndarray,
                          interpret: bool = False,
                          rows_per_block: int = SWAR_ROWS,
@@ -200,30 +245,12 @@ def apply_gf_matrix_swar(coefs: np.ndarray, x: jnp.ndarray,
     w = s // 4
     r = w // LANES
 
-    mbits = bitslice.expand_gf2(np.asarray(coefs, dtype=np.uint8))
-    rows = tuple(tuple(int(t) for t in np.nonzero(mbits[rr])[0])
-                 for rr in range(8 * n_out))
-
     xw = jax.lax.bitcast_convert_type(
         x.reshape(b, n_in, w, 4), jnp.uint32)
     x4 = xw.reshape(b, n_in, r, LANES)
-
-    y4 = pl.pallas_call(
-        _make_swar_kernel(rows, n_in, n_out, cse=cse),
-        grid=(b, r // rows_per_block),
-        in_specs=[pl.BlockSpec(
-            (1, n_in, rows_per_block, LANES),
-            lambda bi, ri: (bi, 0, ri, 0),
-            memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(
-            (1, n_out, rows_per_block, LANES),
-            lambda bi, ri: (bi, 0, ri, 0),
-            memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (b, n_out, r, LANES), jnp.uint32),
-        interpret=interpret,
-    )(x4)
-
+    y4 = apply_gf_matrix_swar_words(coefs, x4, interpret=interpret,
+                                    rows_per_block=rows_per_block,
+                                    cse=cse)
     yw = y4.reshape(b, n_out, w)
     return jax.lax.bitcast_convert_type(yw, jnp.uint8).reshape(b, n_out, s)
 
@@ -257,15 +284,32 @@ def apply_gf_matrix(coefs: np.ndarray, x: jnp.ndarray,
     w = s // 4
     r = w // (GROUP_WORDS * LANES)
 
-    mbits = bitslice.expand_gf2(np.asarray(coefs, dtype=np.uint8))
-    rows = tuple(tuple(int(t) for t in np.nonzero(mbits[rr])[0])
-                 for rr in range(8 * n_out))
-
     xw = jax.lax.bitcast_convert_type(
         x.reshape(b, n_in, w, 4), jnp.uint32)
     x4 = xw.reshape(b, n_in, GROUP_WORDS, r, LANES)
+    y4 = apply_gf_matrix_words(coefs, x4, interpret=interpret, rb=rb,
+                               cse=cse)
+    yw = y4.reshape(b, n_out, w)
+    return jax.lax.bitcast_convert_type(yw, jnp.uint8).reshape(b, n_out, s)
 
-    y4 = pl.pallas_call(
+
+def apply_gf_matrix_words(coefs: np.ndarray, x4: jnp.ndarray,
+                          interpret: bool = False, rb: int = RB,
+                          cse: bool = True) -> jnp.ndarray:
+    """Transpose kernel on the WORD form: x4 (B, n_in, 32, R, 128) u32
+    -> (B, n_out, 32, R, 128) u32 — no u8<->u32 relayout around the
+    kernel (see apply_gf_matrix_swar_words for why that matters)."""
+    n_out, n_in = coefs.shape
+    if (x4.ndim != 5 or x4.shape[1] != n_in
+            or x4.shape[2] != GROUP_WORDS or x4.shape[4] != LANES):
+        raise ValueError(
+            f"x4 must be (B, {n_in}, {GROUP_WORDS}, R, {LANES}) u32, "
+            f"got {x4.shape}")
+    b, _, _, r, _ = x4.shape
+    if r % rb:
+        raise ValueError(f"R={r} must divide by {rb}")
+    rows = _expand_rows(coefs, n_out)
+    return pl.pallas_call(
         _make_kernel(rows, n_in, n_out, cse=cse),
         grid=(b, r // rb),
         in_specs=[pl.BlockSpec(
@@ -280,6 +324,3 @@ def apply_gf_matrix(coefs: np.ndarray, x: jnp.ndarray,
             (b, n_out, GROUP_WORDS, r, LANES), jnp.uint32),
         interpret=interpret,
     )(x4)
-
-    yw = y4.reshape(b, n_out, w)
-    return jax.lax.bitcast_convert_type(yw, jnp.uint8).reshape(b, n_out, s)
