@@ -40,6 +40,11 @@ def _run_scaffold(argv: list[str]) -> int:
     return 0
 
 
+def _run_cluster(argv: list[str]) -> int:
+    from .cluster_launcher import main
+    return main(argv)
+
+
 def _run_tls_gen(argv: list[str]) -> int:
     import argparse
 
@@ -152,6 +157,7 @@ COMMANDS = {
     "compact": _run_compact,
     "scaffold": _run_scaffold,
     "tls.gen": _run_tls_gen,
+    "cluster": _run_cluster,
 }
 
 
