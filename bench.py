@@ -209,6 +209,26 @@ def parent() -> None:
 
     headline = merged.get("headline_gibps")
     core_plat = stage_platforms["core"]
+    if core_plat != platform or core_plat in ("cpu", None):
+        # The tunnel is intermittent (hours-long outages between short
+        # windows): when THIS run could not reach the chip, surface the
+        # most recent real-hardware result the watcher banked — clearly
+        # labeled as prior evidence, never replacing the live value.
+        try:
+            with open(os.path.join(ARTIFACTS, "TPU_SUCCESS"),
+                      "r", encoding="utf-8") as f:
+                banked = json.load(f)
+            extras["tpu_banked_result"] = {
+                "value": banked.get("value"),
+                "unit": banked.get("unit"),
+                "extras": banked.get("extras"),
+                "note": "real-TPU benchmark banked by scripts/"
+                        "tpu_watch.sh during an earlier tunnel window "
+                        "(artifacts/TPU_SUCCESS); this run's chip "
+                        "access degraded",
+            }
+        except (OSError, ValueError):
+            pass
     if headline is None or core_plat is None:
         emit({
             "metric": "rs_10_4_encode_1gib_device",
