@@ -9,15 +9,21 @@ end-to-end file path, alternate geometries, CPU baseline) ride in the same
 JSON under ``extras`` and are echoed to stderr.
 
 Measurement honesty (see PERF.md):
-* The headline streams ~1 GiB through repeated (1, 10, slab) device calls
-  — never one giant ``pallas_call`` (a 1 GiB single call demands a ~55 GB
-  padded HBM layout and cannot compile; slabs of <= 160 MiB input do).
-  On compile failure the slab auto-shrinks (halves) and retries.
-* Every timed loop XOR-accumulates a checksum of each output ON DEVICE and
-  fetches the checksum bytes to host at the end of the window — the clock
-  stops only when real result bytes reached the host, so an early-return
-  ``block_until_ready`` cannot fake the number. Distinct input buffers are
-  used across calls so no result can be cached.
+* The headline races (kernel x slabs-per-dispatch x input form)
+  candidates over ~1 GiB of uploaded 160 MiB slabs — never one giant
+  ``pallas_call`` (single buffers past ~0.3 GiB fail remote compile;
+  multi-arg dispatches of slab-sized args are the proven way to carry
+  more bytes per ~8 ms dispatch). Word-form candidates feed pre-tiled
+  u32 arrays so no XLA relayout rides the timed path. On compile
+  failure the slab auto-shrinks (halves) and retries.
+* Every timed loop XOR-folds a checksum of each output ON DEVICE inside
+  the same executable (accumulator threaded through the jit) and
+  fetches the accumulator bytes at the end of the window — the clock
+  stops only when real result bytes reached the host, so an
+  early-return ``block_until_ready`` cannot fake the number. Distinct
+  input buffers are used across calls so no result can be cached, and
+  every candidate's checksum must match the oracle-smoked reference
+  kernel's before its number can count.
 * Device-resident (compute-only) and host->device->host (end-to-end) are
   measured separately; the e2e number is the PCIe/tunnel-bound figure
   SURVEY.md §7 hard-part-1 predicts.

@@ -43,7 +43,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("-dataCenter", default="")
     p.add_argument("-rack", default="")
     p.add_argument("-volume.index", dest="vol_index", default="memory",
-                   choices=["memory", "sqlite"])
+                   choices=["memory", "native", "sqlite"])
     p.add_argument("-pulseSeconds", type=float, default=5.0)
     p.add_argument("-config", default="")
     args = p.parse_args(argv)
