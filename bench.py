@@ -526,8 +526,11 @@ def child_core() -> None:
         def _swarW64(c, x):  # noqa: F811
             return rs_pallas.apply_gf_matrix_swar_words(
                 c, x, rows_per_block=8, interpret=True)
+
+        def _transpW(c, x):  # noqa: F811
+            return rs_pallas.apply_gf_matrix_words(
+                c, x, interpret=True)
         _swarW512 = None
-        _transpW = None
 
     # One-time, untimed conversion of every slab to the word forms the
     # word candidates consume (HBM: u8 + 4-D + 5-D ~= 3x slab bytes).
@@ -588,6 +591,7 @@ def child_core() -> None:
         candidates = [("transpose", gf_apply, 2, "u8"),
                       ("gate", None, 0, ""),
                       ("swar8", _swar64, 2, "u8"),
+                      ("transpW", _transpW, 2, "w5"),
                       ("swarW8", _swarW64, 2, "w4")]
     else:
         # nargs=8 = 1.25 GiB per dispatch (8 x 160 MiB args): the widest
