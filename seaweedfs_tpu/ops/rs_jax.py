@@ -86,6 +86,14 @@ def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
         @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
             return rs_pallas.apply_gf_matrix_swar(coefs, x)
+    elif variant == "pallas_words":
+        @jax.jit
+        def apply_fn(x4: jnp.ndarray) -> jnp.ndarray:
+            return rs_pallas.apply_gf_matrix_words(coefs, x4)
+    elif variant == "pallas_swar_words":
+        @jax.jit
+        def apply_fn(x4: jnp.ndarray) -> jnp.ndarray:
+            return rs_pallas.apply_gf_matrix_swar_words(coefs, x4)
     elif variant == "xla":
         @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
@@ -101,6 +109,27 @@ def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
             return yc.transpose(1, 2, 0, 3)
 
     return apply_fn
+
+
+class _HostParity:
+    """Async device parity held in word form; ``np.asarray`` (the
+    pipeline writer's sync point) fetches it and re-views the bytes as
+    (B, m, S) uint8 — a zero-copy host reshape."""
+
+    __slots__ = ("dev", "b", "m", "s")
+
+    def __init__(self, dev, b: int, m: int, s: int):
+        self.dev = dev
+        self.b = b
+        self.m = m
+        self.s = s
+
+    def __array__(self, dtype=None, copy=None):
+        w = np.asarray(self.dev)
+        out = w.view(np.uint8).reshape(self.b, self.m, self.s)
+        if dtype is not None and out.dtype != dtype:
+            return out.astype(dtype)
+        return out
 
 
 def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
@@ -177,6 +206,41 @@ class Encoder:
     def encode_parity(self, data) -> jnp.ndarray:
         """data (B, k, S) or (k, S) uint8 -> parity (B, m, S) / (m, S)."""
         return apply_matrix(self.matrix[self.data_shards:], data)
+
+    def encode_parity_host(self, batch):
+        """Pipeline fast path: HOST (B, k, S) uint8 -> async parity
+        whose ``np.asarray`` yields (B, m, S) uint8.
+
+        When the Pallas path applies and the shape conforms, the host
+        array is viewed as the kernel's pre-tiled word form (zero-copy)
+        and fed to the *_words entry point, so no XLA relayout runs on
+        device — the profiler-measured bulk of the u8 path's device
+        time (PERF.md). Anything else defers to encode_parity."""
+        lanes = rs_pallas.LANES
+        if (isinstance(batch, np.ndarray) and batch.ndim == 3
+                and batch.dtype == np.uint8
+                and batch.flags.c_contiguous and FORCE is None
+                and batch.shape[1] == self.data_shards
+                # one dispatch predicate for all call sites
+                and _pick_variant(batch.shape[-1])
+                in ("pallas", "pallas_swar")):
+            b, k, s = batch.shape
+            w = s // 4
+            coefs_b = self.parity_coefs.tobytes()
+            if PALLAS_KERNEL == "swar" and rs_pallas.swar_conforms(s):
+                x = jnp.asarray(batch.view(np.uint32).reshape(
+                    b, k, w // lanes, lanes))
+                fn = _jitted_apply(coefs_b, self.parity_shards, k,
+                                   "pallas_swar_words")
+                return _HostParity(fn(x), b, self.parity_shards, s)
+            if PALLAS_KERNEL != "swar" and rs_pallas.conforms(s):
+                x = jnp.asarray(batch.view(np.uint32).reshape(
+                    b, k, rs_pallas.GROUP_WORDS,
+                    w // (rs_pallas.GROUP_WORDS * lanes), lanes))
+                fn = _jitted_apply(coefs_b, self.parity_shards, k,
+                                   "pallas_words")
+                return _HostParity(fn(x), b, self.parity_shards, s)
+        return self.encode_parity(batch)
 
     def encode_batch(self, data) -> jnp.ndarray:
         """data (..., k, S) -> all shards (..., k+m, S) (data passthrough

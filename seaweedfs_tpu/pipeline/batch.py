@@ -158,7 +158,8 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
                 sink(sp.key, k + j, sp.offset, np.ascontiguousarray(
                     parity[sp.r0:sp.r0 + sp.n, j]))
 
-    pipe.run_pipeline(batches(), scheme.encoder.encode_parity, write)
+    pipe.run_pipeline(batches(), scheme.encoder.encode_parity_host,
+                      write)
     return total
 
 

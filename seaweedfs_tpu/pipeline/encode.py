@@ -66,7 +66,8 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
             np.ascontiguousarray(parity[:, j, :]).tofile(outs[k + j])
 
     try:
-        pipe.run_pipeline(batches(), scheme.encoder.encode_parity, write)
+        pipe.run_pipeline(batches(), scheme.encoder.encode_parity_host,
+                          write)
     finally:
         for f in outs:
             f.close()

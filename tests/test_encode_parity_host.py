@@ -1,0 +1,70 @@
+"""Encoder.encode_parity_host: the pipeline's zero-relayout fast path.
+
+On CPU the accelerator predicate is false, so the fast path must defer
+to encode_parity (covered by every pipeline test). Here the predicate
+is forced and the words kernels run under the Pallas interpreter to
+prove the host word view -> words kernel -> u8 re-view chain is
+byte-exact vs the oracle, for both kernels."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import rs_jax, rs_pallas, rs_ref
+
+
+@pytest.fixture()
+def forced_pallas(monkeypatch):
+    monkeypatch.setattr(rs_jax, "_use_pallas", lambda: True)
+    monkeypatch.setattr(rs_jax, "PALLAS_MIN_S", 1024)
+    real_w = rs_pallas.apply_gf_matrix_words
+    real_s = rs_pallas.apply_gf_matrix_swar_words
+    monkeypatch.setattr(
+        rs_pallas, "apply_gf_matrix_words",
+        lambda c, x, **kw: real_w(c, x, interpret=True))
+    monkeypatch.setattr(
+        rs_pallas, "apply_gf_matrix_swar_words",
+        lambda c, x, **kw: real_s(c, x, rows_per_block=8,
+                                  interpret=True))
+    rs_jax._jitted_apply.cache_clear()
+    yield
+    rs_jax._jitted_apply.cache_clear()
+
+
+def _check(k, m, s, b=2, kernel="transpose", monkeypatch=None):
+    if monkeypatch is not None:
+        monkeypatch.setattr(rs_jax, "PALLAS_KERNEL", kernel)
+    rng = np.random.default_rng(k * 31 + m)
+    x = rng.integers(0, 256, (b, k, s), dtype=np.uint8)
+    enc = rs_jax.Encoder(k, m)
+    out = enc.encode_parity_host(x)
+    assert isinstance(out, rs_jax._HostParity), \
+        f"fast path not taken for {kernel}"
+    got = np.asarray(out)
+    ref = rs_ref.ReferenceEncoder(k, m)
+    want = np.stack([ref.encode_parity(xb) for xb in x])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_transpose_words_fast_path(forced_pallas, monkeypatch):
+    _check(4, 2, rs_pallas.SEG_BYTES, kernel="transpose",
+           monkeypatch=monkeypatch)
+
+
+def test_swar_words_fast_path(forced_pallas, monkeypatch):
+    # swar_conforms uses SWAR_ROWS=512 -> need S % 256 KiB == 0
+    _check(4, 2, rs_pallas.SWAR_SEG_BYTES, b=1, kernel="swar",
+           monkeypatch=monkeypatch)
+
+
+def test_defers_when_not_eligible(forced_pallas):
+    enc = rs_jax.Encoder(4, 2)
+    rng = np.random.default_rng(0)
+    # non-conforming S -> plain encode_parity result (not _HostParity)
+    x = rng.integers(0, 256, (1, 4, 2048), dtype=np.uint8)
+    out = enc.encode_parity_host(x)
+    assert not isinstance(out, rs_jax._HostParity)
+    # non-contiguous input -> defers
+    big = rng.integers(0, 256, (1, 4, 2 * rs_pallas.SEG_BYTES),
+                       dtype=np.uint8)
+    out2 = enc.encode_parity_host(big[..., ::2])
+    assert not isinstance(out2, rs_jax._HostParity)
