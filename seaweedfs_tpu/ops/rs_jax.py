@@ -132,6 +132,45 @@ class _HostParity:
         return out
 
 
+def apply_matrix_host(coefs: np.ndarray, batch):
+    """HOST (B, n_in, S) uint8 -> async result whose ``np.asarray``
+    yields (B, n_out, S) uint8.
+
+    The zero-relayout fast path behind Encoder.encode_parity_host /
+    reconstruct_batch_host: when the Pallas dispatch applies and the
+    shape conforms, the batch is VIEWED (zero-copy) in the kernel's
+    pre-tiled word form and fed to the *_words entry point — none of
+    the XLA copy/reshape/broadcast glue the profiler showed dominating
+    the u8 path's device time (PERF.md). Anything ineligible defers to
+    apply_matrix."""
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    n_out, n_in = coefs.shape
+    lanes = rs_pallas.LANES
+    if (isinstance(batch, np.ndarray) and batch.ndim == 3
+            and batch.dtype == np.uint8
+            and batch.flags.c_contiguous and FORCE is None
+            and batch.shape[1] == n_in
+            # one dispatch predicate for all call sites
+            and _pick_variant(batch.shape[-1])
+            in ("pallas", "pallas_swar")):
+        b, _, s = batch.shape
+        w = s // 4
+        coefs_b = coefs.tobytes()
+        if PALLAS_KERNEL == "swar" and rs_pallas.swar_conforms(s):
+            x = jnp.asarray(batch.view(np.uint32).reshape(
+                b, n_in, w // lanes, lanes))
+            fn = _jitted_apply(coefs_b, n_out, n_in,
+                               "pallas_swar_words")
+            return _HostParity(fn(x), b, n_out, s)
+        if PALLAS_KERNEL != "swar" and rs_pallas.conforms(s):
+            x = jnp.asarray(batch.view(np.uint32).reshape(
+                b, n_in, rs_pallas.GROUP_WORDS,
+                w // (rs_pallas.GROUP_WORDS * lanes), lanes))
+            fn = _jitted_apply(coefs_b, n_out, n_in, "pallas_words")
+            return _HostParity(fn(x), b, n_out, s)
+    return apply_matrix(coefs, batch)
+
+
 def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
     """Dispatch to the fused Pallas kernel (TPU) or the chunked XLA
     network, padding S to the chosen path's granularity and slicing back
@@ -209,38 +248,33 @@ class Encoder:
 
     def encode_parity_host(self, batch):
         """Pipeline fast path: HOST (B, k, S) uint8 -> async parity
-        whose ``np.asarray`` yields (B, m, S) uint8.
+        whose ``np.asarray`` yields (B, m, S) uint8 — see
+        apply_matrix_host."""
+        return apply_matrix_host(self.matrix[self.data_shards:], batch)
 
-        When the Pallas path applies and the shape conforms, the host
-        array is viewed as the kernel's pre-tiled word form (zero-copy)
-        and fed to the *_words entry point, so no XLA relayout runs on
-        device — the profiler-measured bulk of the u8 path's device
-        time (PERF.md). Anything else defers to encode_parity."""
-        lanes = rs_pallas.LANES
-        if (isinstance(batch, np.ndarray) and batch.ndim == 3
-                and batch.dtype == np.uint8
-                and batch.flags.c_contiguous and FORCE is None
-                and batch.shape[1] == self.data_shards
-                # one dispatch predicate for all call sites
-                and _pick_variant(batch.shape[-1])
-                in ("pallas", "pallas_swar")):
-            b, k, s = batch.shape
-            w = s // 4
-            coefs_b = self.parity_coefs.tobytes()
-            if PALLAS_KERNEL == "swar" and rs_pallas.swar_conforms(s):
-                x = jnp.asarray(batch.view(np.uint32).reshape(
-                    b, k, w // lanes, lanes))
-                fn = _jitted_apply(coefs_b, self.parity_shards, k,
-                                   "pallas_swar_words")
-                return _HostParity(fn(x), b, self.parity_shards, s)
-            if PALLAS_KERNEL != "swar" and rs_pallas.conforms(s):
-                x = jnp.asarray(batch.view(np.uint32).reshape(
-                    b, k, rs_pallas.GROUP_WORDS,
-                    w // (rs_pallas.GROUP_WORDS * lanes), lanes))
-                fn = _jitted_apply(coefs_b, self.parity_shards, k,
-                                   "pallas_words")
-                return _HostParity(fn(x), b, self.parity_shards, s)
-        return self.encode_parity(batch)
+    def reconstruct_batch_host(self, shards, present: Sequence[int],
+                               wanted: Optional[Sequence[int]] = None):
+        """reconstruct_batch for HOST survivor arrays — rides the
+        zero-relayout word-form path when eligible (apply_matrix_host).
+        ``shards``: (B, len(present), S) uint8 np array."""
+        rows = self._decode_rows_for(present, wanted)
+        chosen = shards[:, :self.data_shards, :]
+        if (isinstance(chosen, np.ndarray)
+                and not chosen.flags.c_contiguous):
+            chosen = np.ascontiguousarray(chosen)
+        return apply_matrix_host(rows, chosen)
+
+    def _decode_rows_for(self, present: Sequence[int],
+                         wanted: Optional[Sequence[int]]) -> np.ndarray:
+        """Shared front half of the reconstruct paths: default wanted
+        to every missing shard and build the decode rows."""
+        present = list(present)
+        if wanted is None:
+            missing = set(range(self.total_shards)) - set(present)
+            wanted = sorted(missing)
+        if not wanted:
+            raise ValueError("nothing to reconstruct")
+        return self.decode_matrix_rows(present, wanted)
 
     def encode_batch(self, data) -> jnp.ndarray:
         """data (..., k, S) -> all shards (..., k+m, S) (data passthrough
@@ -294,13 +328,7 @@ class Encoder:
         ordered to match ``present``. ``wanted``: which absolute shard ids
         to produce (default: every missing one). Returns (B, len(wanted), S).
         """
-        present = list(present)
-        if wanted is None:
-            missing = set(range(self.total_shards)) - set(present)
-            wanted = sorted(missing)
-        if not wanted:
-            raise ValueError("nothing to reconstruct")
-        rows = self.decode_matrix_rows(present, wanted)
+        rows = self._decode_rows_for(present, wanted)
         shards = jnp.asarray(shards, dtype=jnp.uint8)
         chosen = shards[..., :self.data_shards, :]
         return apply_matrix(rows, chosen)

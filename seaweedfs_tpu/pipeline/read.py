@@ -93,7 +93,7 @@ class EcVolumeReader:
                                          shard_id)
         else:
             chunk = np.stack(rows)[None]
-            out = np.asarray(self.scheme.encoder.reconstruct_batch(
+            out = np.asarray(self.scheme.encoder.reconstruct_batch_host(
                 chunk, present, [shard_id]))[0, 0]
         self.intervals_repaired += 1
         return out

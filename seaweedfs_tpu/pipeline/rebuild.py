@@ -60,7 +60,7 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
             take = min(chunk_bytes, size - pos)
             chunk = np.stack([
                 np.frombuffer(f.read(take), dtype=np.uint8) for f in ins])
-            rebuilt = np.asarray(scheme.encoder.reconstruct_batch(
+            rebuilt = np.asarray(scheme.encoder.reconstruct_batch_host(
                 chunk[None], present, missing))[0]
             for row, f in zip(rebuilt, outs):
                 row.tofile(f)

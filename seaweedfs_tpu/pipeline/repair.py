@@ -136,7 +136,7 @@ class IntervalRepairAggregator:
                         arr[i, :, :r.size] = r.rows[
                             :self.scheme.data_shards]
                     out = np.asarray(
-                        self.scheme.encoder.reconstruct_batch(
+                        self.scheme.encoder.reconstruct_batch_host(
                             arr, list(present), [wanted]))
                     for i, r in enumerate(reqs):
                         r.future.set_result(out[i, 0, :r.size].copy())

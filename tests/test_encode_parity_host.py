@@ -68,3 +68,21 @@ def test_defers_when_not_eligible(forced_pallas):
                        dtype=np.uint8)
     out2 = enc.encode_parity_host(big[..., ::2])
     assert not isinstance(out2, rs_jax._HostParity)
+
+
+def test_reconstruct_batch_host_fast_path(forced_pallas, monkeypatch):
+    monkeypatch.setattr(rs_jax, "PALLAS_KERNEL", "transpose")
+    k, m, s = 4, 2, rs_pallas.SEG_BYTES
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, 256, (1, k, s), dtype=np.uint8)
+    enc = rs_jax.Encoder(k, m)
+    ref = rs_ref.ReferenceEncoder(k, m)
+    parity = ref.encode_parity(x[0])
+    full = np.concatenate([x[0], parity])
+    present = [0, 2, 3, 4]  # lost shards 1 (data) and 5 (parity)
+    surv = np.ascontiguousarray(full[present])[None]
+    out = enc.reconstruct_batch_host(surv, present, [1, 5])
+    assert isinstance(out, rs_jax._HostParity), "fast path not taken"
+    got = np.asarray(out)
+    np.testing.assert_array_equal(got[0, 0], full[1])
+    np.testing.assert_array_equal(got[0, 1], full[5])
