@@ -27,7 +27,7 @@ while true; do
     TS=$(date +%s)
     echo "$TS tpu up; running full bench then probe3" >> "$LOG"
     touch artifacts/tpu.lock
-    timeout 2400 python bench.py \
+    timeout 3000 python bench.py \
       > "artifacts/BENCH_attempt_$TS.json" \
       2> "artifacts/BENCH_attempt_$TS.log"
     BRC=$?
