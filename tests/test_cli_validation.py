@@ -1,0 +1,50 @@
+"""CLI argument validation and small command surfaces."""
+
+import subprocess
+import sys
+
+
+def _run(*args, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        capture_output=True, text=True, timeout=timeout,
+        cwd="/root/repo")
+
+
+def test_cluster_requires_filer_for_s3(tmp_path):
+    r = _run("cluster", "-dir", str(tmp_path), "-s3")
+    assert r.returncode == 2
+    assert "-s3 requires -filer" in r.stderr
+
+
+def test_cluster_requires_filer_for_webdav(tmp_path):
+    r = _run("cluster", "-dir", str(tmp_path), "-webdav")
+    assert r.returncode == 2
+    assert "-webdav requires -filer" in r.stderr
+
+
+def test_unknown_command():
+    r = _run("frobnicate")
+    assert r.returncode == 1
+    assert "unknown command" in r.stderr
+
+
+def test_help_lists_every_command():
+    r = _run("help")
+    for cmd in ("master", "volume", "filer", "shell", "cluster",
+                "tls.gen", "mount", "s3", "webdav", "benchmark"):
+        assert cmd in r.stderr, cmd
+
+
+def test_tls_gen_writes_pair(tmp_path):
+    r = _run("tls.gen", "-dir", str(tmp_path / "certs"))
+    assert r.returncode == 0
+    for key in ("ca =", "cert =", "key ="):
+        assert key in r.stdout
+    assert (tmp_path / "certs" / "cluster.key").exists()
+
+
+def test_scaffold_security_mentions_tls():
+    r = _run("scaffold", "-config", "security")
+    assert r.returncode == 0
+    assert "[grpc.tls]" in r.stdout
