@@ -118,15 +118,18 @@ class LocalCluster:
                 ["filer", "-port", str(self.port_base + 200),
                  "-master", self.master_urls[0]] + sec,
                 self.base / "filer.log")
+        # Gateways take TLS credentials via -securityConfig (on the s3
+        # gateway, -config means identities JSON, not security.toml).
+        gwsec = (["-securityConfig", self.config] if self.config else [])
         if self.with_s3:
             self.procs["s3"] = _spawn(
                 ["s3", "-port", str(self.port_base + 300),
-                 "-filer", self.filer_url],
+                 "-filer", self.filer_url] + gwsec,
                 self.base / "s3.log")
         if self.with_webdav:
             self.procs["webdav"] = _spawn(
                 ["webdav", "-port", str(self.port_base + 400),
-                 "-filer", self.filer_url],
+                 "-filer", self.filer_url] + gwsec,
                 self.base / "webdav.log")
         self._write_manifest()
         return self

@@ -324,6 +324,11 @@ class S3Gateway:
             marker = self.filer.lookup(d, "key")
             owner = (marker.extended.get("bucket", b"").decode()
                      if marker is not None else "")
+            # Markers written before the bucket attribute existed have
+            # owner == "" and skip the check (back-compat: such legacy
+            # in-flight uploads remain drivable from any bucket the
+            # caller can Write). New markers always carry the attribute,
+            # so the window closes as old uploads complete or expire.
             if owner and owner != bucket:
                 raise S3Error("NoSuchUpload", upload_id)
         return d

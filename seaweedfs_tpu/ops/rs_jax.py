@@ -47,10 +47,18 @@ FORCE: Optional[str] = None
 #: the SEAWEEDFS_TPU_KERNEL environment variable so a measured winner
 #: can be promoted without a code change.
 PALLAS_KERNEL = os.environ.get("SEAWEEDFS_TPU_KERNEL", "transpose")
-if PALLAS_KERNEL not in ("transpose", "swar"):
-    raise ValueError(
-        f"SEAWEEDFS_TPU_KERNEL={PALLAS_KERNEL!r}: expected 'transpose' "
-        f"or 'swar'")
+
+
+def _kernel() -> str:
+    """Validated kernel selection, checked at *use* time rather than at
+    import so a typo'd SEAWEEDFS_TPU_KERNEL surfaces as a normal error
+    from the encode call instead of a bare traceback from every CLI
+    entrypoint that transitively imports this module."""
+    if PALLAS_KERNEL not in ("transpose", "swar"):
+        raise ValueError(
+            f"SEAWEEDFS_TPU_KERNEL={PALLAS_KERNEL!r}: expected "
+            f"'transpose' or 'swar'")
+    return PALLAS_KERNEL
 
 
 def _use_pallas() -> bool:
@@ -63,7 +71,7 @@ def _pick_variant(s: int) -> str:
     if FORCE:
         return FORCE
     if _use_pallas() and s >= PALLAS_MIN_S:
-        return "pallas_swar" if PALLAS_KERNEL == "swar" else "pallas"
+        return "pallas_swar" if _kernel() == "swar" else "pallas"
     if jax.default_backend() == "cpu" and rs_native.available():
         # Measured on this host: the AVX2 nibble-LUT codec beats the
         # XLA:CPU bitslice network ~10x, so it IS the CPU fallback
@@ -156,13 +164,13 @@ def apply_matrix_host(coefs: np.ndarray, batch):
         b, _, s = batch.shape
         w = s // 4
         coefs_b = coefs.tobytes()
-        if PALLAS_KERNEL == "swar" and rs_pallas.swar_conforms(s):
+        if _kernel() == "swar" and rs_pallas.swar_conforms(s):
             x = jnp.asarray(batch.view(np.uint32).reshape(
                 b, n_in, w // lanes, lanes))
             fn = _jitted_apply(coefs_b, n_out, n_in,
                                "pallas_swar_words")
             return _HostParity(fn(x), b, n_out, s)
-        if PALLAS_KERNEL != "swar" and rs_pallas.conforms(s):
+        if _kernel() != "swar" and rs_pallas.conforms(s):
             x = jnp.asarray(batch.view(np.uint32).reshape(
                 b, n_in, rs_pallas.GROUP_WORDS,
                 w // (rs_pallas.GROUP_WORDS * lanes), lanes))

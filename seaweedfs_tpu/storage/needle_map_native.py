@@ -2,7 +2,9 @@
 
 Same surface as idx.CompactMap (set/get/delete/len/live_entries/
 items/close + the bookkeeping fields the store status, heartbeats, and
-vacuum scheduler read), but entries live in one C open-addressing array
+vacuum scheduler read) — except ``items()``, which yields only LIVE
+entries where CompactMap also yields tombstones (see the method
+comment). Entries live in one C open-addressing array
 at ~24 B/slot instead of a Python dict at ~200 B/entry — the
 weed/storage/needle_map/compact_map.go role (RAM-frugal index is the
 Haystack design's core), built in C++ per the native-runtime mandate.
@@ -156,6 +158,10 @@ class NativeNeedleMap:
         return out
 
     def items(self) -> Iterator[IndexEntry]:
+        # Divergence from idx.CompactMap.items(): only LIVE entries are
+        # yielded — tombstoned keys (size 0xFFFFFFFF) are dropped by
+        # nm_dump_live. Callers that need deletion markers (e.g. a
+        # vacuum-style diff) must use the CompactMap index kind.
         return iter(self.live_entries())
 
     def close(self) -> None:

@@ -36,6 +36,37 @@ def _free_port_block(span: int = 500):
     raise RuntimeError("no free port block")
 
 
+def test_gateways_get_security_config(tmp_path, monkeypatch):
+    """-config (security.toml) must reach the gateways as
+    -securityConfig: their -config flag means identities JSON on s3, so
+    forwarding the toml there (or dropping it) leaves the gateways
+    dialing the filer's mTLS gRPC port in plaintext."""
+    import seaweedfs_tpu.cluster_launcher as cl
+
+    spawned = {}
+
+    class _P:
+        pid = 0
+
+        def poll(self):
+            return None
+
+    def fake_spawn(argv, log_path):
+        spawned[argv[0]] = argv
+        return _P()
+
+    monkeypatch.setattr(cl, "_spawn", fake_spawn)
+    cl.LocalCluster(tmp_path, masters=1, volumes=1, filer=True,
+                    s3=True, webdav=True, config="/tmp/sec.toml").start()
+    for role in ("s3", "webdav"):
+        argv = spawned[role]
+        assert "-securityConfig" in argv
+        assert argv[argv.index("-securityConfig") + 1] == "/tmp/sec.toml"
+        assert "-config" not in argv  # identities JSON ≠ security.toml
+    # servers keep taking it as -config
+    assert "-config" in spawned["master"]
+
+
 def test_launcher_end_to_end(tmp_path):
     base = _free_port_block()
     with LocalCluster(tmp_path, masters=1, volumes=2, filer=True,
