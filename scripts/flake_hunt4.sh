@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-5 flake proof (VERDICT item 5): N serial full-suite runs, each
+# under a deliberate CPU-load antagonist (the judge reproduced the
+# replication timeout only when another heavy process overlapped the
+# suite on this single-core host). Pauses while artifacts/tpu.lock is
+# held so suite+antagonist load never distorts a benchmark window.
+# Failures land in artifacts/flake4_fail_<n>.log with full tracebacks.
+set -u
+cd /root/repo || exit 1
+N=${1:-10}
+LOG=artifacts/flake_hunt4.log
+for i in $(seq 1 "$N"); do
+  while [ -f artifacts/tpu.lock ]; do sleep 60; done
+  # antagonist: pure-CPU spinner competing for the single core
+  python - <<'PY' &
+import time
+t0 = time.time()
+while time.time() - t0 < 900:
+    sum(j * j for j in range(10000))
+PY
+  SPIN=$!
+  T0=$(date +%s)
+  if python -m pytest tests/ -q -rf --tb=long \
+       > "artifacts/flake4_run.log" 2>&1; then
+    echo "$(date +%s) run $i PASS ($(( $(date +%s) - T0 ))s)" >> "$LOG"
+  else
+    cp artifacts/flake4_run.log "artifacts/flake4_fail_$i.log"
+    echo "$(date +%s) run $i FAIL -> flake4_fail_$i.log" >> "$LOG"
+  fi
+  kill "$SPIN" 2>/dev/null
+  wait "$SPIN" 2>/dev/null
+done
+echo "$(date +%s) done ($N runs)" >> "$LOG"

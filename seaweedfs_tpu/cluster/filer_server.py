@@ -203,12 +203,17 @@ class _FilerServicer:
         context.add_callback(stop.set)
         prefix = request.path_prefix or "/"
         for ev in self.fs.filer.subscribe(stop,
-                                          since_ns=request.since_ns):
+                                          since_ns=request.since_ns,
+                                          hello=True):
             if not context.is_active():
                 stop.set()
                 return
             want = "/" if prefix == "/" else normalize_path(prefix) + "/"
-            if not (ev.directory + "/").startswith(want):
+            is_hello = ev.old_entry is None and ev.new_entry is None
+            # the hello marker (entry-less, ts = this filer's clock at
+            # registration) always passes the prefix filter — followers
+            # use it as an attach barrier + skew-free resume point
+            if not is_hello and not (ev.directory + "/").startswith(want):
                 continue
             note = filer_pb2.EventNotification(
                 delete_chunks=ev.new_entry is None)

@@ -194,9 +194,13 @@ class Filer:
 
     def _notify(self, directory: str, old: Optional[Entry],
                 new: Optional[Entry]) -> None:
-        ev = MetaEvent(ts_ns=time.time_ns(), directory=directory,
-                       old_entry=old, new_entry=new)
         with self._lock:
+            # Stamp under the lock: timestamp order == log order, so a
+            # subscriber's attach stamp (hello_ts, taken under this
+            # same lock) is a true barrier — every event appended after
+            # registration carries ts >= it.
+            ev = MetaEvent(ts_ns=time.time_ns(), directory=directory,
+                           old_entry=old, new_entry=new)
             self._meta_log.append(ev)
             subs = list(self._subs)
         for s in subs:
@@ -220,8 +224,8 @@ class Filer:
 
     def subscribe(self, stop: Optional[threading.Event] = None,
                   since_ns: int = 0,
-                  registered: Optional[threading.Event] = None
-                  ) -> Iterator[MetaEvent]:
+                  registered: Optional[threading.Event] = None,
+                  hello: bool = False) -> Iterator[MetaEvent]:
         """Blocking event stream (SubscribeMetadata). Iterate on a
         dedicated thread; set ``stop`` to end the stream.
 
@@ -232,7 +236,13 @@ class Filer:
         (if given) is set the moment the subscriber is attached — a
         caller that must not miss events (the notifier bridge, before
         its server opens ports) waits on it, because a generator body
-        only runs at the first next()."""
+        only runs at the first next().
+
+        ``hello=True`` first yields a marker MetaEvent (no entries)
+        whose ts_ns is THIS filer's clock at registration, stamped
+        under the log lock: every later-delivered event has ts >= it,
+        so a remote follower can adopt it as a skew-free resume point
+        and as proof the stream is attached."""
         sub = _Subscriber()
         with self._lock:
             if since_ns and not self.meta_log_covers(since_ns):
@@ -242,9 +252,13 @@ class Filer:
             replay = [ev for ev in self._meta_log
                       if ev.ts_ns > since_ns] if since_ns else []
             self._subs.append(sub)
+            hello_ts = time.time_ns()
         if registered is not None:
             registered.set()
         try:
+            if hello:
+                yield MetaEvent(ts_ns=hello_ts, directory="",
+                                old_entry=None, new_entry=None)
             for ev in replay:
                 if stop is not None and stop.is_set():
                     return

@@ -70,6 +70,8 @@ def _use_pallas() -> bool:
 def _pick_variant(s: int) -> str:
     if FORCE:
         return FORCE
+    _kernel()  # validate the env knob on EVERY backend, not just TPU —
+    # a typo must not ride silently through CPU runs into a deployment
     if _use_pallas() and s >= PALLAS_MIN_S:
         return "pallas_swar" if _kernel() == "swar" else "pallas"
     if jax.default_backend() == "cpu" and rs_native.available():

@@ -1,12 +1,16 @@
 """The replicator: source filer meta-stream -> sink (weed/replication's
 Replicator + filer.replicate command role).
 
-Runs an optional bootstrap pass (recursive listing of the source tree,
-applied as creates — covers history older than the meta-log window),
-then follows ``SubscribeMetadata`` from just before the bootstrap
-snapshot so nothing written during the walk is missed; the sink's
-mtime/size idempotence absorbs the overlap. Reconnects with backoff on
-stream failure, resuming from the last applied event timestamp.
+Attach-then-walk bootstrap: the ``SubscribeMetadata`` stream is opened
+FIRST (live-only — never needs meta-log coverage, so a re-sync always
+converges) and its hello marker, stamped by the source's clock under
+its log lock, becomes the resume point; only then is the source tree
+walked and applied as creates. History is covered by the walk, walk-
+concurrent mutations by the already-open stream, and the sink's
+mtime/size idempotence absorbs any overlap. Reconnects with backoff on
+stream failure, resuming (with meta-log replay) from the last applied
+event's source-clock timestamp; if the log window has expired, the
+source errors and the follower re-syncs with a fresh attach-then-walk.
 """
 
 from __future__ import annotations
@@ -34,19 +38,37 @@ class Replicator:
         self.path_prefix = "/" + path_prefix.strip("/")
         self.client_name = client_name
         self.bootstrap = bootstrap
+        #: Source-clock resume point: the ts of the newest applied event
+        #: or, before any event, the hello stamp adopted at attach (the
+        #: source filer's clock under its log lock) — never this host's
+        #: clock, so no skew cushion is needed anywhere.
         self.last_ts_ns = 0
         self.applied = 0
         self.errors = 0
+        #: Notified after EVERY sink apply (success or error) — tests
+        #: and operators wait on this instead of sleep-polling the sink.
+        self.applied_cond = threading.Condition()
+        #: Set once a subscribe stream is attached (hello received);
+        #: events from that instant on are guaranteed delivered/replayed.
+        self.attached = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._channel = None
 
     # ------------- lifecycle -------------
 
-    def start(self) -> "Replicator":
+    def start(self, wait_attach: float = 10.0) -> "Replicator":
+        """Start following. Blocks (up to ``wait_attach`` seconds) until
+        the meta stream is attached, so a mutation made after start()
+        returns is guaranteed to replicate even without bootstrap — the
+        attach barrier is the source's hello stamp, not a clock guess.
+        With the source down this times out and the follower keeps
+        retrying in the background."""
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="filer-replicator")
         self._thread.start()
+        if wait_attach:
+            self.attached.wait(wait_attach)
         return self
 
     def stop(self) -> None:
@@ -72,24 +94,25 @@ class Replicator:
                 f"{ip}:{_grpc_port(int(http_port))}")
         return pb.filer_stub(self._channel)
 
-    #: Clock-skew cushion for the bootstrap/stream seam: events are
-    #: stamped by the SOURCE filer's clock, so the resume point backs
-    #: off this much; the sink's signature idempotence makes the
-    #: resulting over-replay free.
-    SKEW_NS = 60 * 1_000_000_000
-
     def _run(self) -> None:
         need_bootstrap = self.bootstrap
         backoff = 0.2
         while not self._stop.is_set():
             try:
                 if need_bootstrap:
-                    # Resume point BEFORE the walk (minus skew cushion)
-                    # so mutations racing the bootstrap are replayed.
-                    self.last_ts_ns = time.time_ns() - self.SKEW_NS
-                    self._bootstrap()
-                    need_bootstrap = False
-                self._follow()
+                    # Attach the LIVE stream first (never needs log
+                    # coverage, so a re-sync always converges), adopt
+                    # its hello stamp as the resume point, THEN walk
+                    # the tree: history is covered by the walk, walk-
+                    # concurrent mutations by the already-open stream.
+                    def _walk_done():
+                        nonlocal need_bootstrap
+                        self._bootstrap()
+                        need_bootstrap = False
+                    self.last_ts_ns = 0
+                    self._follow(on_attach=_walk_done)
+                else:
+                    self._follow()
                 backoff = 0.2
             except Exception as e:  # noqa: BLE001 — reconnect
                 if self._stop.is_set():
@@ -130,22 +153,51 @@ class Replicator:
     def _apply(self, path: str, new_entry, old_entry=None) -> None:
         try:
             self.sink.apply(path, new_entry, old_entry)
-            self.applied += 1
+            with self.applied_cond:
+                self.applied += 1
+                self.applied_cond.notify_all()
         except Exception as e:  # noqa: BLE001 — one bad entry, not all
-            self.errors += 1
+            with self.applied_cond:
+                self.errors += 1
+                self.applied_cond.notify_all()
             glog.warning("replication: apply %s failed: %s", path, e)
 
-    def _follow(self) -> None:
+    def wait_converged(self, pred, timeout: float = 45.0) -> bool:
+        """Event-driven convergence wait: re-check ``pred`` after every
+        applied event (waking immediately via applied_cond) until it
+        holds or ``timeout`` elapses. Returns whether it held — the
+        deadline is a failsafe, not the synchronization mechanism.
+
+        ``pred`` (often slow I/O — a sink lookup) runs OUTSIDE the
+        condition lock so the apply path's lock hold stays O(1); the
+        counter re-check under the lock closes the missed-notify gap."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self.applied_cond:
+                n = self.applied + self.errors
+            if pred():
+                return True
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return bool(pred())
+            with self.applied_cond:
+                self.applied_cond.wait_for(
+                    lambda: self.applied + self.errors != n,
+                    timeout=min(left, 1.0))
+
+    def _follow(self, on_attach=None) -> None:
         # Resume one tick early: the filer's replay filter is strictly
         # ``>``, and two mutations can share a coarse-clock timestamp —
         # an equal-ts event after the last applied one must not be
         # skipped (re-applying the applied one is free via the sink's
-        # signature check).
+        # signature check). last_ts_ns == 0 means attach live-only and
+        # adopt the hello stamp (the source's clock at registration).
+        live_only = self.last_ts_ns == 0
         stream = self._stub().SubscribeMetadata(
             filer_pb2.SubscribeMetadataRequest(
                 client_name=self.client_name,
                 path_prefix=self.path_prefix,
-                since_ns=max(0, self.last_ts_ns - 1)))
+                since_ns=0 if live_only else max(0, self.last_ts_ns - 1)))
         for resp in stream:
             if self._stop.is_set():
                 return
@@ -154,6 +206,17 @@ class Replicator:
             old = note.old_entry if note.old_entry.name else None
             name = (new or old).name if (new or old) else ""
             if not name:
+                # hello marker: stream is attached. Its ts only becomes
+                # the resume point on a live-only attach — during a
+                # replay it is newer than the queued history and would
+                # skip it on the next break.
+                if live_only:
+                    self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
+                self.attached.set()  # before any walk: attached means
+                # "stream open", not "bootstrap finished"
+                if on_attach is not None:
+                    on_attach()
+                    on_attach = None
                 continue
             path = resp.directory.rstrip("/") + "/" + name
             self._apply(path, new, old)

@@ -198,6 +198,8 @@ def run_watch(argv: list[str] | None = None) -> int:
     try:
         for resp in stream:
             note = resp.event_notification
+            if not note.new_entry.name and not note.old_entry.name:
+                continue  # hello/attach marker, not a mutation
             kind = ("delete" if not note.new_entry.name else
                     "create" if not note.old_entry.name else "update")
             name = (note.new_entry.name or note.old_entry.name)

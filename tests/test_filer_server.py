@@ -203,9 +203,15 @@ def test_subscribe_metadata_stream(stack):
     stub = pb.filer_stub(ch)
     stream = stub.SubscribeMetadata(
         filer_pb2.SubscribeMetadataRequest(client_name="t"))
-    time.sleep(0.2)  # let the server register the subscriber
+    it = iter(stream)
+    # first response is the hello marker: entry-less, ts = the filer's
+    # clock at registration — the attach barrier (no sleep needed)
+    hello = next(it)
+    assert not hello.event_notification.new_entry.name
+    assert not hello.event_notification.old_entry.name
+    assert hello.ts_ns > 0
     _put(filer, "/sub/notify.txt", b"hi")
-    ev = next(iter(stream))
+    ev = next(it)
     assert ev.event_notification.new_entry.name in ("sub", "notify.txt")
     stream.cancel()
     ch.close()

@@ -34,9 +34,8 @@ def _free_port_pair():
 
 
 def _wait_for(pred, timeout=45.0, what="condition"):
-    # 45 s: events cannot be LOST (since_ns replay), only late — and on
-    # this single-core host a concurrent heavy process (flake-hunt run 4
-    # overlapping a full suite) starved the 15 s ceiling into a flake.
+    # Only for waits with no Replicator in the loop; replicator tests
+    # use _converge (event-driven via applied_cond, no sleep-polling).
     deadline = time.time() + timeout
     while time.time() < deadline:
         v = pred()
@@ -44,6 +43,14 @@ def _wait_for(pred, timeout=45.0, what="condition"):
             return v
         time.sleep(0.05)
     raise AssertionError(f"timed out waiting for {what}")
+
+
+def _converge(rep, pred, what="condition", timeout=45.0):
+    """Event-driven: wakes on every applied event; the deadline is a
+    failsafe against genuine bugs, not the synchronization mechanism
+    (the old 0.05 s poll loop starved under parallel-suite host load)."""
+    if not rep.wait_converged(pred, timeout=timeout):
+        raise AssertionError(f"timed out waiting for {what}")
 
 
 @pytest.fixture(scope="module")
@@ -109,34 +116,34 @@ def test_two_filers_converge(two_filers):
         ca.put_data("/site/deep/b.bin", bytes(range(256)) * 100)
         rep = Replicator(fa.url, FilerSink(ca, cb),
                          path_prefix="/").start()
-        _wait_for(lambda: cb.lookup("/site", "a.txt") is not None,
+        _converge(rep, lambda: cb.lookup("/site", "a.txt") is not None,
                   what="bootstrap of a.txt")
-        _wait_for(lambda: cb.lookup("/site/deep", "b.bin") is not None,
+        _converge(rep, lambda: cb.lookup("/site/deep", "b.bin") is not None,
                   what="bootstrap of deep/b.bin")
         assert cb.get_data("/site/a.txt") == b"alpha"
         assert cb.get_data("/site/deep/b.bin") == bytes(range(256)) * 100
 
         # live writes converge
         ca.put_data("/site/c.txt", b"gamma")
-        _wait_for(lambda: cb.lookup("/site", "c.txt") is not None,
+        _converge(rep, lambda: cb.lookup("/site", "c.txt") is not None,
                   what="live create")
         assert cb.get_data("/site/c.txt") == b"gamma"
 
         # overwrite converges
         ca.put_data("/site/a.txt", b"alpha-v2")
-        _wait_for(lambda: _content(cb, "/site/a.txt") == b"alpha-v2",
+        _converge(rep, lambda: _content(cb, "/site/a.txt") == b"alpha-v2",
                   what="live overwrite")
 
         # rename converges (delete + create events)
         ca.rename("/site", "c.txt", "/site", "c2.txt")
-        _wait_for(lambda: cb.lookup("/site", "c2.txt") is not None
+        _converge(rep, lambda: cb.lookup("/site", "c2.txt") is not None
                   and cb.lookup("/site", "c.txt") is None,
                   what="rename convergence")
         assert cb.get_data("/site/c2.txt") == b"gamma"
 
         # delete converges
         ca.delete_data("/site/a.txt")
-        _wait_for(lambda: cb.lookup("/site", "a.txt") is None,
+        _converge(rep, lambda: cb.lookup("/site", "a.txt") is None,
                   what="delete convergence")
         assert rep.errors == 0
     finally:
@@ -160,13 +167,13 @@ def test_replicator_resumes_after_stream_break(two_filers):
                      bootstrap=False).start()
     try:
         ca.put_data("/resume/x.txt", b"x1")
-        _wait_for(lambda: cb.lookup("/resume", "x.txt") is not None,
+        _converge(rep, lambda: cb.lookup("/resume", "x.txt") is not None,
                   what="first replication")
         # Break the stream; events during the outage must replay from
         # the meta-log when the replicator reconnects.
         rep._channel.close()
         ca.put_data("/resume/y.txt", b"y1")
-        _wait_for(lambda: cb.lookup("/resume", "y.txt") is not None,
+        _converge(rep, lambda: cb.lookup("/resume", "y.txt") is not None,
                   what="post-outage catch-up")
         assert cb.get_data("/resume/y.txt") == b"y1"
     finally:
@@ -208,13 +215,13 @@ def test_replicator_resyncs_after_window_expiry(two_filers):
                      bootstrap=False).start()
     try:
         ca.put_data("/exp/first.txt", b"1")
-        _wait_for(lambda: cb.lookup("/exp", "first.txt") is not None,
+        _converge(rep, lambda: cb.lookup("/exp", "first.txt") is not None,
                   what="first replication")
         rep._channel.close()  # outage
         for i in range(12):   # overflow the window during the outage
             ca.put_data(f"/exp/burst{i}.txt", b"b")
         # the replicator must detect the gap and re-sync the tree
-        _wait_for(lambda: all(
+        _converge(rep, lambda: all(
             cb.lookup("/exp", f"burst{i}.txt") is not None
             for i in range(12)), what="re-sync after window expiry")
     finally:
@@ -245,13 +252,13 @@ def test_s3_sink_replicates_into_gateway(two_filers, tmp_path):
         sink = S3Sink(ca, gw.url, "repbucket", key_prefix="mirror")
         rep = Replicator(fa.url, sink, path_prefix="/s3rep").start()
         ca.put_data("/s3rep/obj.txt", b"to-the-bucket")
-        _wait_for(lambda: _s3_get(gw, "/repbucket/mirror/s3rep/obj.txt")
+        _converge(rep, lambda: _s3_get(gw, "/repbucket/mirror/s3rep/obj.txt")
                   == b"to-the-bucket", what="s3 sink create")
         ca.put_data("/s3rep/obj.txt", b"v2")
-        _wait_for(lambda: _s3_get(gw, "/repbucket/mirror/s3rep/obj.txt")
+        _converge(rep, lambda: _s3_get(gw, "/repbucket/mirror/s3rep/obj.txt")
                   == b"v2", what="s3 sink overwrite")
         ca.delete_data("/s3rep/obj.txt")
-        _wait_for(lambda: _s3_get(gw, "/repbucket/mirror/s3rep/obj.txt")
+        _converge(rep, lambda: _s3_get(gw, "/repbucket/mirror/s3rep/obj.txt")
                   is None, what="s3 sink delete")
     finally:
         if rep is not None:
