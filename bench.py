@@ -688,6 +688,82 @@ def child_core() -> None:
     log(f"device-resident encode best ({best_name or 'cpu-fold'}): "
         f"{compute_gibps:.2f} GiB/s (target {TARGET_GIBPS})")
 
+    # -- HBM roofline honesty figure (VERDICT r4 item 7) ------------------
+    # v5e HBM is 819 GB/s; an RS(k,m) encode must move at least
+    # (read k + write m)/k = (k+m)/k bytes of HBM traffic per input
+    # byte, so the physics bound on *input* throughput is HBM/(1+m/k).
+    # roofline_frac says how far the measured number is from physics,
+    # independent of the 20 GiB/s target constant.
+    if on_acc and not interp:
+        hbm_gibps = 819e9 / GIB
+        roofline = hbm_gibps / ((k + m) / k)
+        res["hbm_roofline_gibps"] = round(roofline, 1)
+        res["roofline_frac"] = round(compute_gibps / roofline, 5)
+        log(f"HBM roofline (v5e 819 GB/s, {(k + m) / k:.1f}x traffic): "
+            f"{roofline:.0f} GiB/s input bound -> measured is "
+            f"{100 * res['roofline_frac']:.2f}% of physics")
+        _persist(res)
+
+    # -- production-dispatch smoke (VERDICT r4 item 2): the bytes users
+    # get from Encoder.encode_parity_host (host u8 slab -> zero-copy
+    # word view -> upload -> words kernel -> _HostParity re-view) must
+    # match the oracle-smoked kernel, and its cached executable must
+    # run at race speed — proving the auto dispatch ships the raced
+    # number, not a glue-laden cousin.
+    if on_acc and not interp and "w5" in slab_forms:
+        try:
+            from seaweedfs_tpu.ops import rs_jax as rs_jax_mod
+            old_policy = rs_jax_mod.HOST_DISPATCH
+            rs_jax_mod.HOST_DISPATCH = "device"  # smoke the device leg
+            try:
+                hp = enc.encode_parity_host(host_slabs[0])
+                if not isinstance(hp, rs_jax_mod._HostParity):
+                    raise AssertionError(
+                        "production dispatch did not take the word-form "
+                        "device path")
+                got = np.asarray(hp)
+                want = np.asarray(encode_fn(dev_slabs[0]))
+                if not np.array_equal(got, want):
+                    raise AssertionError(
+                        "production-path parity != oracle-smoked kernel")
+            finally:
+                rs_jax_mod.HOST_DISPATCH = old_policy
+            # time the exact executable the production dispatch cached
+            fnp = rs_jax_mod._jitted_apply(
+                coefs.tobytes(), m, k, "pallas_words")
+            w5 = slab_forms["w5"]
+            for d in w5:
+                fnp(d)  # warm
+            y = None
+            t0 = time.perf_counter()
+            for _ in range(passes):
+                for d in w5:
+                    y = fnp(d)
+            # single device stream: fetching the LAST output's bytes
+            # means every queued kernel before it has run (slice ON
+            # DEVICE first — np.asarray(y) whole would drag 160 MiB
+            # through the tunnel and poison the timing)
+            np.asarray(y[..., :1])
+            t_d = time.perf_counter() - t0
+            d_gibps = passes * len(w5) * per_call / GIB / t_d
+            res["dispatch_device_gibps"] = round(d_gibps, 3)
+            race_ref = max(
+                (v for kk, v in res.items() if kk.startswith(
+                    "headline_transpW_") and kk.endswith("_gibps")
+                    and isinstance(v, (int, float))), default=None)
+            if race_ref:
+                res["dispatch_vs_race_frac"] = round(d_gibps / race_ref, 3)
+            res["dispatch_path_ok"] = True
+            log(f"production dispatch (encode_parity_host words path): "
+                f"bytes OK, executable {d_gibps:.2f} GiB/s"
+                + (f" ({100 * res['dispatch_vs_race_frac']:.0f}% of "
+                   f"raced transpW)" if race_ref else ""))
+        except Exception as e:  # noqa: BLE001 — smoke must not kill core
+            res["dispatch_path_ok"] = False
+            res["dispatch_path_error"] = f"{type(e).__name__}: {e}"[:200]
+            log(f"production-dispatch smoke failed: {e}")
+        _persist(res)
+
     # optional profiler trace of one pass of the plain encode (never fatal)
     try:
         trace_dir = os.path.join(ARTIFACTS, "jax_trace_r04")
@@ -1068,10 +1144,14 @@ def child_config5() -> None:
     interval repairs ride the micro-batch aggregator.
 
     On the accelerator a device-resident 4-loss reconstruct rate is
-    reported alongside the e2e harness numbers: the harness's decode
-    and p99 ride the ~24 MiB/s tunnel (file IO + H2D + D2H per chunk),
-    so they measure this environment's link, not the chip's repair
-    math."""
+    reported alongside the e2e harness numbers. The harness itself now
+    rides the HYBRID dispatch policy (rs_jax): sub-slab interval
+    repairs always take the host AVX2 codec (a 4 KiB repair must never
+    pay a device round trip), and bulk chunks cross to the device only
+    when the measured link outruns the host codec — so on the ~24 MiB/s
+    tunnel the harness reports an honest hybrid number instead of
+    round 4's 0.009 GiB/s / 10 s p99 all-device disaster, and on a
+    locally attached chip the same code uses the device."""
     import numpy as np
 
     from seaweedfs_tpu.pipeline import repair_bench
@@ -1125,6 +1205,25 @@ def child_config5() -> None:
                 # shape-dependent numbers: record the workload geometry
                 # so cross-round trend comparisons stay apples-to-apples
                 "repair_shard_len_mib": shard_len // MIB})
+    # Surface which leg the hybrid dispatcher chose (and why): with a
+    # degraded link the harness honestly rides the host codec; a local
+    # chip crosses to the device word path. The chip's own repair math
+    # is repair_decode_device_gibps above either way.
+    try:
+        from seaweedfs_tpu.ops import rs_jax as rs_jax_mod
+        if rs_jax_mod._link_gibps is not None:
+            res["dispatch_link_gibps"] = round(rs_jax_mod._link_gibps, 3)
+            res["dispatch_native_gibps"] = round(
+                rs_jax_mod._native_gibps, 3)
+            res["repair_dispatch"] = (
+                "device" if rs_jax_mod._link_gibps >
+                rs_jax_mod._native_gibps else "hybrid-native")
+            log(f"config-5 hybrid dispatch: link "
+                f"{res['dispatch_link_gibps']} GiB/s vs native "
+                f"{res['dispatch_native_gibps']} GiB/s -> "
+                f"{res['repair_dispatch']}")
+    except Exception:  # noqa: BLE001 — observability only
+        pass
     _persist(res)
     print(json.dumps(res), flush=True)
 

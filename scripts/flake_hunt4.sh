@@ -11,11 +11,11 @@ N=${1:-10}
 LOG=artifacts/flake_hunt4.log
 for i in $(seq 1 "$N"); do
   while [ -f artifacts/tpu.lock ]; do sleep 60; done
-  # antagonist: pure-CPU spinner competing for the single core
+  # antagonist: pure-CPU spinner competing for the single core for the
+  # WHOLE suite run (no time cap — a capped spinner silently unloads
+  # the late tests); the kill below ends it
   python - <<'PY' &
-import time
-t0 = time.time()
-while time.time() - t0 < 900:
+while True:
     sum(j * j for j in range(10000))
 PY
   SPIN=$!

@@ -33,9 +33,32 @@ class AssignResult:
 
 
 def assign(master: MasterClient, count: int = 1, collection: str = "",
-           replication: str = "", ttl: str = "") -> AssignResult:
-    r = master.assign(count=count, collection=collection,
-                      replication=replication, ttl=ttl)
+           replication: str = "", ttl: str = "",
+           retry_s: float = 3.0) -> AssignResult:
+    """Ask the master for a file id + target volume server.
+
+    An empty topology is often TRANSIENT — a heartbeat starved past the
+    reap deadline on a loaded host, or a just-failed-over master that
+    has not heard from the volume servers yet; the node re-registers on
+    its next pulse. A brief bounded retry (``retry_s``) absorbs that
+    window instead of failing the caller's write; persistent
+    no-capacity still surfaces as the original error."""
+    import time as time_mod
+
+    deadline = time_mod.monotonic() + retry_s
+    wait = 0.1
+    while True:
+        try:
+            r = master.assign(count=count, collection=collection,
+                              replication=replication, ttl=ttl)
+            break
+        except RuntimeError as e:
+            transient = ("no data node" in str(e)
+                         or "free slots" in str(e))
+            if not transient or time_mod.monotonic() >= deadline:
+                raise
+            time_mod.sleep(wait)
+            wait = min(wait * 2, 0.5)
     return AssignResult(fid=r["fid"], url=r["url"],
                         public_url=r["publicUrl"] or r["url"],
                         count=r["count"], auth=r.get("auth", ""))

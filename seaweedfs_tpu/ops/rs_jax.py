@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -41,6 +42,28 @@ XLA_CHUNK_S = 4 * 1024 * 1024
 #: Test/debug override: "pallas" | "pallas_swar" | "native" | "xla" |
 #: None (auto).
 FORCE: Optional[str] = None
+#: Hybrid policy, part 2 (large HOST payloads): "auto" measures the
+#: host->device link and the native codec once and sends host-resident
+#: slabs to the device only when the link can stream bytes faster than
+#: the host codec computes them (otherwise the transfer alone loses the
+#: race — on this environment's ~24 MiB/s tunnel the device can never
+#: win an e2e host encode, while a locally attached chip always can).
+#: "device" / "native" pin the choice (the bench pins "device" to smoke
+#: the production device path regardless of the link).
+HOST_DISPATCH = os.environ.get("SEAWEEDFS_TPU_HOST_DISPATCH", "auto")
+_link_gibps: Optional[float] = None
+_native_gibps: Optional[float] = None
+_calibrate_lock = threading.Lock()
+
+
+def _dispatch_mode() -> str:
+    """Validated HOST_DISPATCH, checked at use time on every backend
+    (same rationale as _kernel())."""
+    if HOST_DISPATCH not in ("auto", "device", "native"):
+        raise ValueError(
+            f"SEAWEEDFS_TPU_HOST_DISPATCH={HOST_DISPATCH!r}: expected "
+            f"'auto', 'device' or 'native'")
+    return HOST_DISPATCH
 #: Which Pallas kernel the auto "pallas" variant uses: "transpose"
 #: (default — oracle-smoked on hardware every bench round) or "swar"
 #: (transpose-free; see rs_pallas.apply_gf_matrix_swar). Overridable via
@@ -70,16 +93,81 @@ def _use_pallas() -> bool:
 def _pick_variant(s: int) -> str:
     if FORCE:
         return FORCE
-    _kernel()  # validate the env knob on EVERY backend, not just TPU —
-    # a typo must not ride silently through CPU runs into a deployment
+    _kernel()  # validate the env knobs on EVERY backend, not just TPU —
+    _dispatch_mode()  # a typo must not ride silently through CPU runs
+    # into a deployment
     if _use_pallas() and s >= PALLAS_MIN_S:
         return "pallas_swar" if _kernel() == "swar" else "pallas"
-    if jax.default_backend() == "cpu" and rs_native.available():
-        # Measured on this host: the AVX2 nibble-LUT codec beats the
-        # XLA:CPU bitslice network ~10x, so it IS the CPU fallback
-        # (the reference's "falls back to SIMD CPU path").
+    if rs_native.available():
+        # Hybrid policy, part 1 (sub-slab work): below PALLAS_MIN_S the
+        # dispatch+grid overhead beats any device win EVEN with a local
+        # chip, so small payloads take the AVX2 nibble-LUT codec on the
+        # host on EVERY backend — a 4 KiB interval repair must never
+        # pay a device round trip (round-4 bench: 64 QPS of them on the
+        # tunneled TPU drove read p99 to ~10 s; the reference serves
+        # them from klauspost's SIMD loop for the same reason).
         return "native"
     return "xla"
+
+
+def _measure_link_gibps(n_bytes: int = 8 * 1024 * 1024) -> float:
+    """One-time h2d+d2h round-trip bandwidth probe (GiB/s of payload
+    moved per second of wall time, both directions counted)."""
+    import time
+
+    x = np.zeros(n_bytes, dtype=np.uint8)
+    t0 = time.perf_counter()
+    d = jax.device_put(x)
+    jax.block_until_ready(d)
+    np.asarray(d)
+    dt = time.perf_counter() - t0
+    return 2 * n_bytes / (1024 ** 3) / max(dt, 1e-9)
+
+
+def _measure_native_gibps(n_bytes: int = 16 * 1024 * 1024) -> float:
+    """One-time host-codec throughput probe (input GiB/s)."""
+    import time
+
+    k = 10
+    coefs = gf256.build_code_matrix(k, k + 4)[k:]
+    x = np.zeros((k, n_bytes // k), dtype=np.uint8)
+    rs_native.apply_gf_matrix(coefs, x)  # warm: builds .so + tables
+    t0 = time.perf_counter()
+    rs_native.apply_gf_matrix(coefs, x)
+    dt = time.perf_counter() - t0
+    return x.size / (1024 ** 3) / max(dt, 1e-9)
+
+
+def _device_worth_it() -> bool:
+    """Hybrid policy, part 2: should a large HOST payload cross to the
+    device? Probes both bandwidths once; the device wins only when the
+    link outruns the host codec (see HOST_DISPATCH)."""
+    mode = _dispatch_mode()
+    if mode == "device":
+        return True
+    if mode == "native":
+        return False
+    if not rs_native.available():
+        return True
+    global _link_gibps, _native_gibps
+    if _link_gibps is None:
+        with _calibrate_lock:
+            # re-check under the lock: concurrent callers (the repair
+            # aggregator + a bulk decode run in parallel by design)
+            # must neither double-probe nor share the link with each
+            # other's probe — that would cache a distorted verdict for
+            # the process lifetime
+            if _link_gibps is None:
+                link = _measure_link_gibps()
+                _native_gibps = _measure_native_gibps()
+                _link_gibps = link
+                from ..util import glog
+                glog.v(1, "rs dispatch calibration: link %.3f GiB/s, "
+                          "native codec %.3f GiB/s -> host slabs %s",
+                       _link_gibps, _native_gibps,
+                       "cross to device" if _link_gibps > _native_gibps
+                       else "stay on host")
+    return _link_gibps > _native_gibps
 
 
 @functools.lru_cache(maxsize=256)
@@ -163,6 +251,10 @@ def apply_matrix_host(coefs: np.ndarray, batch):
             # one dispatch predicate for all call sites
             and _pick_variant(batch.shape[-1])
             in ("pallas", "pallas_swar")):
+        if not _device_worth_it():
+            # link slower than the host codec: crossing can only lose
+            y = rs_native.apply_gf_matrix(coefs, batch)
+            return y
         b, _, s = batch.shape
         w = s // 4
         coefs_b = coefs.tobytes()
@@ -181,10 +273,14 @@ def apply_matrix_host(coefs: np.ndarray, batch):
     return apply_matrix(coefs, batch)
 
 
-def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
+def apply_matrix(coefs: np.ndarray, x) -> "np.ndarray | jnp.ndarray":
     """Dispatch to the fused Pallas kernel (TPU) or the chunked XLA
     network, padding S to the chosen path's granularity and slicing back
-    (zero bytes encode to zero parity, so padding is transparent)."""
+    (zero bytes encode to zero parity, so padding is transparent).
+
+    Returns a device array, EXCEPT on the native host-codec leg with a
+    host numpy input, where the host-resident result is returned as
+    plain numpy (uploading it would defeat the hybrid policy)."""
     coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
     n_out, n_in = coefs.shape
     if getattr(x, "ndim", None) not in (2, 3):
@@ -192,11 +288,21 @@ def apply_matrix(coefs: np.ndarray, x) -> jnp.ndarray:
             f"expected (n_in, S) or (B, n_in, S), got {getattr(x, 'shape', x)}")
     squeeze = x.ndim == 2
     variant = _pick_variant(x.shape[-1])
+    if variant == "native" and FORCE is None \
+            and not isinstance(x, np.ndarray) \
+            and jax.default_backend() != "cpu":
+        # never DOWNLOAD a device-resident array just to use the host
+        # codec — the hybrid policy only redirects host payloads. On
+        # the CPU backend a jax.Array is already host memory, so the
+        # (~10x faster) native codec stays the right choice there.
+        variant = "xla"
     if variant == "native":
         # Stay on the host end to end — converting through a device
-        # buffer first would add two full copies of the payload.
-        y = rs_native.apply_gf_matrix(coefs, np.asarray(x, dtype=np.uint8))
-        return jnp.asarray(y)
+        # buffer first would add two full copies of the payload, and on
+        # a non-CPU backend jnp.asarray would UPLOAD the result, so the
+        # host-resident answer is returned as plain numpy.
+        return rs_native.apply_gf_matrix(coefs,
+                                         np.asarray(x, dtype=np.uint8))
     x = jnp.asarray(x, dtype=jnp.uint8)
     if squeeze:
         x = x[None]

@@ -140,7 +140,10 @@ def run(duration_s: float = 8.0, qps: int = 64,
             size = min(bulk_chunk, shard_len - off)
             rows = np.stack([read_interval(i, off, size)
                              for i in survivors[:k]])
-            out = np.asarray(scheme.encoder.reconstruct_batch(
+            # _host variant: rides the hybrid dispatch policy (device
+            # word-form path when the link can feed the chip, host
+            # codec otherwise) instead of forcing an upload
+            out = np.asarray(scheme.encoder.reconstruct_batch_host(
                 rows[None], survivors[:k], list(lost)))
             if verify and ci < len(lost):
                 j = ci  # spot-check one lost shard per early chunk

@@ -28,6 +28,58 @@ from .sinks import ReplicationSink
 from ..util import tls as tls_mod
 
 
+class _WalkHold:
+    """Runs the bootstrap walk on a side thread while the stream
+    consumer keeps draining; walk-concurrent events are buffered and
+    applied IN ORDER once the walk finishes (by the walker itself,
+    under the lock), reproducing the safe walk-then-replay ordering —
+    a live delete must not be overtaken by the walk's stale create."""
+
+    def __init__(self, rep: "Replicator", walk_fn):
+        self._rep = rep
+        self._lock = threading.Lock()
+        self._buffer: list = []
+        self._done = False
+        self._err: Optional[BaseException] = None
+
+        def run():
+            try:
+                walk_fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                self._err = e
+            finally:
+                with self._lock:
+                    self._done = True
+                    if self._err is None:
+                        for path, new, old, ts in self._buffer:
+                            rep._apply(path, new, old)
+                            rep.last_ts_ns = max(rep.last_ts_ns, ts)
+                    self._buffer.clear()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="replicator-bootstrap")
+        self._thread.start()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Join the walk — a reconnecting _follow must not start a
+        second walk while this one still runs (double applies, and the
+        old flush would interleave with the new attach)."""
+        self._thread.join(timeout)
+
+    def offer(self, path, new, old, ts_ns) -> bool:
+        """Buffer an event if the walk is still running; False once the
+        walk (and the buffered flush) completed."""
+        with self._lock:
+            if not self._done:
+                self._buffer.append((path, new, old, ts_ns))
+                return True
+            return False
+
+    def raise_if_failed(self) -> None:
+        if self._err is not None:
+            raise self._err
+
+
 class Replicator:
     def __init__(self, source_filer_url: str, sink: ReplicationSink,
                  path_prefix: str = "/",
@@ -198,29 +250,49 @@ class Replicator:
                 client_name=self.client_name,
                 path_prefix=self.path_prefix,
                 since_ns=0 if live_only else max(0, self.last_ts_ns - 1)))
-        for resp in stream:
-            if self._stop.is_set():
-                return
-            note = resp.event_notification
-            new = note.new_entry if note.new_entry.name else None
-            old = note.old_entry if note.old_entry.name else None
-            name = (new or old).name if (new or old) else ""
-            if not name:
-                # hello marker: stream is attached. Its ts only becomes
-                # the resume point on a live-only attach — during a
-                # replay it is newer than the queued history and would
-                # skip it on the next break.
-                if live_only:
-                    self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
-                self.attached.set()  # before any walk: attached means
-                # "stream open", not "bootstrap finished"
-                if on_attach is not None:
-                    on_attach()
-                    on_attach = None
-                continue
-            path = resp.directory.rstrip("/") + "/" + name
-            self._apply(path, new, old)
-            self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
+        hold: Optional[_WalkHold] = None
+        try:
+            for resp in stream:
+                if self._stop.is_set():
+                    return
+                note = resp.event_notification
+                new = note.new_entry if note.new_entry.name else None
+                old = note.old_entry if note.old_entry.name else None
+                name = (new or old).name if (new or old) else ""
+                if not name:
+                    # hello marker: stream is attached. Its ts only
+                    # becomes the resume point on a live-only attach —
+                    # during a replay it is newer than the queued
+                    # history and would skip it on the next break.
+                    if live_only:
+                        self.last_ts_ns = max(self.last_ts_ns,
+                                              resp.ts_ns)
+                    self.attached.set()  # before any walk: attached
+                    # means "stream open", not "bootstrap finished"
+                    if on_attach is not None:
+                        # walk on a SIDE thread while this loop keeps
+                        # draining the stream: a long walk must not let
+                        # the source's bounded subscriber queue overflow
+                        # (that would force a re-sync of the very walk
+                        # in progress — a livelock on big trees under
+                        # sustained writes)
+                        hold = _WalkHold(self, on_attach)
+                        on_attach = None
+                    continue
+                path = resp.directory.rstrip("/") + "/" + name
+                if hold is not None:
+                    if hold.offer(path, new, old, resp.ts_ns):
+                        continue  # buffered; applied after the walk
+                    hold.raise_if_failed()
+                    hold = None
+                self._apply(path, new, old)
+                self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
+        finally:
+            # the walk survives a stream break (it rides its own HTTP
+            # client); finish it before any reconnect so a second walk
+            # can never run concurrently with this one
+            if hold is not None:
+                hold.wait()
 
 
 def main(argv: Optional[list[str]] = None) -> int:

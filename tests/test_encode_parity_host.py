@@ -16,6 +16,9 @@ from seaweedfs_tpu.ops import rs_jax, rs_pallas, rs_ref
 def forced_pallas(monkeypatch):
     monkeypatch.setattr(rs_jax, "_use_pallas", lambda: True)
     monkeypatch.setattr(rs_jax, "PALLAS_MIN_S", 1024)
+    # pin the hybrid policy to the device leg: these tests prove the
+    # word-form device path, not the link-vs-codec routing (below)
+    monkeypatch.setattr(rs_jax, "HOST_DISPATCH", "device")
     real_w = rs_pallas.apply_gf_matrix_words
     real_s = rs_pallas.apply_gf_matrix_swar_words
     monkeypatch.setattr(
@@ -68,6 +71,61 @@ def test_defers_when_not_eligible(forced_pallas):
                        dtype=np.uint8)
     out2 = enc.encode_parity_host(big[..., ::2])
     assert not isinstance(out2, rs_jax._HostParity)
+
+
+def test_hybrid_policy_routes_by_bandwidth(forced_pallas, monkeypatch):
+    """auto: host slabs cross to the device only when the measured link
+    outruns the host codec; otherwise they stay on the AVX2 path."""
+    pytest.importorskip("seaweedfs_tpu.ops.rs_native")
+    from seaweedfs_tpu.ops import rs_native
+    if not rs_native.available():
+        pytest.skip("native codec unavailable")
+    monkeypatch.setattr(rs_jax, "HOST_DISPATCH", "auto")
+    k, m, s = 4, 2, rs_pallas.SEG_BYTES
+    rng = np.random.default_rng(9)
+    x = rng.integers(0, 256, (1, k, s), dtype=np.uint8)
+    enc = rs_jax.Encoder(k, m)
+    want = np.stack([rs_ref.ReferenceEncoder(k, m).encode_parity(x[0])])
+    # slow link (tunnel-like): stays host-side, still byte-exact
+    monkeypatch.setattr(rs_jax, "_link_gibps", 0.02)
+    monkeypatch.setattr(rs_jax, "_native_gibps", 2.0)
+    out = enc.encode_parity_host(x)
+    assert isinstance(out, np.ndarray), "host leg not taken on slow link"
+    np.testing.assert_array_equal(np.asarray(out), want)
+    # fast link (local chip): crosses to the device word path
+    monkeypatch.setattr(rs_jax, "_link_gibps", 50.0)
+    out2 = enc.encode_parity_host(x)
+    assert isinstance(out2, rs_jax._HostParity), \
+        "device leg not taken on fast link"
+    np.testing.assert_array_equal(np.asarray(out2), want)
+
+
+def test_small_payloads_use_native_on_any_backend(monkeypatch):
+    """Hybrid policy part 1: sub-PALLAS_MIN_S host payloads take the
+    host codec even when the backend is an accelerator — and a
+    device-resident array is NEVER downloaded for it."""
+    from seaweedfs_tpu.ops import rs_native
+    if not rs_native.available():
+        pytest.skip("native codec unavailable")
+    monkeypatch.setattr(rs_jax, "_use_pallas", lambda: True)
+    assert rs_jax._pick_variant(4096) == "native"
+    # On an ACCELERATOR backend a device-resident input must NOT pick
+    # the host codec (that would force a d2h download): apply_matrix
+    # falls to xla. (On the real CPU backend a jax.Array is host
+    # memory, so native remains correct there.)
+    monkeypatch.setattr(rs_jax.jax, "default_backend", lambda: "tpu")
+    import jax.numpy as jnp
+    k, m = 4, 2
+    enc = rs_jax.Encoder(k, m)
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 256, (1, k, 4096), dtype=np.uint8)
+    want = np.stack([rs_ref.ReferenceEncoder(k, m).encode_parity(x[0])])
+    y_host = enc.encode_parity(x)           # np input -> native
+    assert isinstance(y_host, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(y_host), want)
+    y_dev = enc.encode_parity(jnp.asarray(x))   # jnp input -> xla
+    assert not isinstance(y_dev, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(y_dev), want)
 
 
 def test_reconstruct_batch_host_fast_path(forced_pallas, monkeypatch):
