@@ -9,6 +9,10 @@ set -u
 cd /root/repo || exit 1
 N=${1:-10}
 LOG=artifacts/flake_hunt4.log
+SPIN=""
+# a killed hunt must not orphan the infinite spinner on this
+# single-core host (it would distort every later benchmark window)
+trap '[ -n "$SPIN" ] && kill "$SPIN" 2>/dev/null' EXIT
 for i in $(seq 1 "$N"); do
   while [ -f artifacts/tpu.lock ]; do sleep 60; done
   # antagonist: pure-CPU spinner competing for the single core for the

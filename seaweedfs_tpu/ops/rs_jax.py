@@ -251,8 +251,10 @@ def apply_matrix_host(coefs: np.ndarray, batch):
             # one dispatch predicate for all call sites
             and _pick_variant(batch.shape[-1])
             in ("pallas", "pallas_swar")):
-        if not _device_worth_it():
-            # link slower than the host codec: crossing can only lose
+        if not _device_worth_it() and rs_native.available():
+            # link slower than the host codec: crossing can only lose.
+            # (Pinned "native" without a built codec falls through to
+            # the device leg instead of crashing.)
             y = rs_native.apply_gf_matrix(coefs, batch)
             return y
         b, _, s = batch.shape

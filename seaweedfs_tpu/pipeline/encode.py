@@ -30,6 +30,19 @@ class EcEncodeError(RuntimeError):
     pass
 
 
+def _require_local_dat(base: str | Path) -> Path:
+    datp = volume_mod.dat_path(base)
+    if not datp.exists():
+        from ..storage import tier as tier_mod
+        if tier_mod.TierInfo.maybe_load(base) is not None:
+            raise EcEncodeError(
+                f"volume {base} is tiered to S3; run "
+                f"volume.tier.download first (EC encode streams the "
+                f"whole .dat — do it from local disk, not ranged GETs)")
+        raise EcEncodeError(f"{datp} does not exist")
+    return datp
+
+
 def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
                    max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES) -> int:
     """Generate <base>.ec00..ec<k+m-1> from <base>.dat. Returns the .dat
@@ -41,9 +54,7 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     written straight from the host batch — k/m of the D2H traffic never
     happens), and a writer thread appends while the next batch computes.
     """
-    datp = volume_mod.dat_path(base)
-    if not datp.exists():
-        raise EcEncodeError(f"{datp} does not exist")
+    datp = _require_local_dat(base)
     # memmap, not fromfile: host residency stays O(batch), not O(volume).
     dat = np.memmap(datp, dtype=np.uint8, mode="r") \
         if datp.stat().st_size else np.zeros(0, dtype=np.uint8)
@@ -90,7 +101,7 @@ def encode_volume(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     way `ec.encode` deletes the source volume after spreading shards).
     The .vif records the volume's actual needle version (from the
     superblock) so readers and decode parse records correctly."""
-    with open(volume_mod.dat_path(base), "rb") as f:
+    with open(_require_local_dat(base), "rb") as f:
         version = superblock_mod.SuperBlock.parse(f.read(8)).version
     dat_size = write_ec_files(base, scheme, max_batch_bytes)
     write_ecx_file(base)

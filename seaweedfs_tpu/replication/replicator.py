@@ -33,28 +33,50 @@ class _WalkHold:
     consumer keeps draining; walk-concurrent events are buffered and
     applied IN ORDER once the walk finishes (by the walker itself,
     under the lock), reproducing the safe walk-then-replay ordering —
-    a live delete must not be overtaken by the walk's stale create."""
+    a live delete must not be overtaken by the walk's stale create.
 
-    def __init__(self, rep: "Replicator", walk_fn):
+    The buffer is bounded: a walk so long that MAX_BUFFER events land
+    during it cannot preserve ordering in memory, so the hold errors
+    with a re-sync (same contract as the source's own queue bound).
+    A failed walk CANCELS the stream — on a quiet source no further
+    event would otherwise arrive to surface the failure, leaving the
+    replicator healthy-looking but missing most of the tree."""
+
+    MAX_BUFFER = 10_000
+
+    def __init__(self, rep: "Replicator", walk_fn, cancel_stream=None):
         self._rep = rep
         self._lock = threading.Lock()
         self._buffer: list = []
         self._done = False
+        self._overflow = False
         self._err: Optional[BaseException] = None
 
         def run():
+            err: Optional[BaseException] = None
             try:
                 walk_fn()
             except BaseException as e:  # noqa: BLE001 — surfaced below
-                self._err = e
-            finally:
-                with self._lock:
-                    self._done = True
-                    if self._err is None:
-                        for path, new, old, ts in self._buffer:
-                            rep._apply(path, new, old)
-                            rep.last_ts_ns = max(rep.last_ts_ns, ts)
-                    self._buffer.clear()
+                err = e
+            with self._lock:
+                self._done = True
+                if err is None and self._overflow:
+                    err = RuntimeError(
+                        "bootstrap event buffer overflow; full "
+                        "re-sync required")
+                self._err = err
+                if err is None:
+                    for path, new, old, ts in self._buffer:
+                        rep._apply(path, new, old)
+                        rep.last_ts_ns = max(rep.last_ts_ns, ts)
+                self._buffer.clear()
+            if err is not None:
+                glog.warning("replication bootstrap failed: %s", err)
+                if cancel_stream is not None:
+                    try:
+                        cancel_stream()
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="replicator-bootstrap")
@@ -71,7 +93,10 @@ class _WalkHold:
         walk (and the buffered flush) completed."""
         with self._lock:
             if not self._done:
-                self._buffer.append((path, new, old, ts_ns))
+                if len(self._buffer) >= self.MAX_BUFFER:
+                    self._overflow = True
+                else:
+                    self._buffer.append((path, new, old, ts_ns))
                 return True
             return False
 
@@ -276,7 +301,8 @@ class Replicator:
                         # (that would force a re-sync of the very walk
                         # in progress — a livelock on big trees under
                         # sustained writes)
-                        hold = _WalkHold(self, on_attach)
+                        hold = _WalkHold(self, on_attach,
+                                         cancel_stream=stream.cancel)
                         on_attach = None
                     continue
                 path = resp.directory.rstrip("/") + "/" + name
@@ -290,9 +316,14 @@ class Replicator:
         finally:
             # the walk survives a stream break (it rides its own HTTP
             # client); finish it before any reconnect so a second walk
-            # can never run concurrently with this one
+            # can never run concurrently with this one — and surface
+            # its failure/overflow even when the stream ended first
+            # (the overflow error carries "re-sync required" so _run
+            # re-walks instead of resuming over dropped events)
             if hold is not None:
                 hold.wait()
+                if not self._stop.is_set():
+                    hold.raise_if_failed()
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -286,6 +286,85 @@ def cmd_volume_vacuum(env: CommandEnv, argv: list[str]) -> None:
                         f"reclaimed, now {new_size} bytes")
 
 
+def _volume_base(env: CommandEnv, vid: int, collection: str):
+    """(volume, base) for a volume id — open in the store or on disk."""
+    vol = env.store.volumes.get((collection, vid))
+    if vol is not None:
+        return vol, vol.base
+    base = next(
+        (loc.base_for(vid, collection)
+         for loc in env.store.locations
+         if Path(str(loc.base_for(vid, collection)) + ".dat").exists()
+         or Path(str(loc.base_for(vid, collection)) + ".tier").exists()),
+        None)
+    if base is None:
+        raise ShellError(f"volume {vid} not found")
+    return None, base
+
+
+@command("volume.tier.upload")
+def cmd_volume_tier_upload(env: CommandEnv, argv: list[str]) -> None:
+    """Move a sealed volume's .dat to an S3 endpoint (the project's own
+    gateway works) and keep serving reads through ranged GETs —
+    command_volume_tier_upload.go over storage/tier.py. The hot .idx
+    stays local; the volume becomes read-only until tier.download."""
+    from ..storage import tier as tier_mod
+    p = _parser("volume.tier.upload")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dest", required=True,
+                   help="endpoint/bucket, e.g. 127.0.0.1:8333/coldstore")
+    p.add_argument("-accessKey", default="")
+    p.add_argument("-secretKey", default="")
+    p.add_argument("-keepLocal", action="store_true")
+    args = p.parse_args(argv)
+    endpoint, _, bucket = args.dest.rpartition("/")
+    if not endpoint or not bucket:
+        raise ShellError(f"bad -dest {args.dest!r}, want endpoint/bucket")
+    vol, base = _volume_base(env, args.volumeId, args.collection)
+    if vol is not None:
+        vol.sync()
+        vol.close()
+    try:
+        info = tier_mod.upload_volume_dat(
+            base, endpoint, bucket,
+            access_key=args.accessKey, secret_key=args.secretKey,
+            remove_local=not args.keepLocal)
+    finally:
+        if vol is not None:
+            # reopen whatever state the tier move left (tiered or not)
+            env.store.volumes[(args.collection, args.volumeId)] = \
+                type(vol)(base, args.volumeId,
+                          needle_map=vol.needle_map_kind).load()
+    env.store.readonly.add((args.collection, args.volumeId))
+    env.println(f"volume.tier.upload {args.volumeId}: {info.size} bytes "
+                f"-> {info.endpoint}/{info.bucket}/{info.key}"
+                + (" (local copy kept)" if args.keepLocal else ""))
+
+
+@command("volume.tier.download")
+def cmd_volume_tier_download(env: CommandEnv, argv: list[str]) -> None:
+    """Bring a tiered volume's .dat back to local disk and drop the
+    sidecar (command_volume_tier_download.go)."""
+    from ..storage import tier as tier_mod
+    p = _parser("volume.tier.download")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    vol, base = _volume_base(env, args.volumeId, args.collection)
+    if vol is not None:
+        vol.close()
+    try:
+        tier_mod.download_volume_dat(base)
+    finally:
+        if vol is not None:
+            env.store.volumes[(args.collection, args.volumeId)] = \
+                type(vol)(base, args.volumeId,
+                          needle_map=vol.needle_map_kind).load()
+    env.store.readonly.discard((args.collection, args.volumeId))
+    env.println(f"volume.tier.download {args.volumeId}: local again")
+
+
 @command("volume.delete")
 def cmd_volume_delete(env: CommandEnv, argv: list[str]) -> None:
     p = _parser("volume.delete")

@@ -137,10 +137,19 @@ class MmapFile(DiskFile):
         super().close()
 
 
+def _s3_factory(path, create: bool = False) -> BackendStorageFile:
+    from .tier import S3TierFile
+    return S3TierFile.from_dat_path(path, create=create)
+
+
 #: name -> factory(path, create) registry (the -backend flag surface).
+#: "s3" is the cold tier (storage/tier.py): read-only range GETs
+#: against an S3 endpoint, selected automatically by Volume.load when a
+#: .tier sidecar exists.
 BACKENDS: dict[str, Callable[..., BackendStorageFile]] = {
     "disk": DiskFile,
     "mmap": MmapFile,
+    "s3": _s3_factory,
 }
 
 

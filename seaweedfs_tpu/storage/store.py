@@ -71,12 +71,19 @@ class DiskLocation:
         return self.directory / volume_base_name(volume_id, collection)
 
     def scan_volumes(self) -> Iterator[tuple[str, int, Path]]:
-        """Yield (collection, vid, base) for every <base>.dat present."""
-        for p in sorted(self.directory.glob("*.dat")):
+        """Yield (collection, vid, base) for every <base>.dat present —
+        and every .tier sidecar (an S3-tiered volume has no local .dat
+        but must still mount on restart)."""
+        seen = set()
+        for p in sorted(self.directory.glob("*.dat")) + \
+                sorted(self.directory.glob("*.tier")):
             try:
                 col, vid = parse_base_name(p.stem)
             except ValueError:
                 continue
+            if (col, vid) in seen:
+                continue
+            seen.add((col, vid))
             yield col, vid, p.with_suffix("")
 
     def scan_ec_shards(self) -> Iterator[tuple[str, int, Path, list[int]]]:

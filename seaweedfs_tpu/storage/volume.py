@@ -65,6 +65,10 @@ class Volume:
         self._idx = None
         #: Guard: at most one compaction in flight (storage/vacuum.py).
         self.vacuum_in_progress = False
+        #: Set when a .tier sidecar exists (the durable copy is on the
+        #: S3 tier): writes are refused even on a kept local .dat, or
+        #: they would silently diverge from the tiered bytes.
+        self.readonly = False
         # Appends mutate shared file-handle state; reads use os.pread on
         # the raw fd, so only writers serialize (volume server threads
         # hit one Volume concurrently). Readers register under the lock
@@ -141,8 +145,17 @@ class Volume:
 
     def load(self) -> "Volume":
         p = dat_path(self.base)
+        from . import tier as tier_mod
+        tiered = tier_mod.TierInfo.maybe_load(self.base) is not None
         if not p.exists():
+            if tiered:
+                return self._load_tiered()
             raise VolumeError(f"{p} does not exist")
+        if tiered:
+            # -keepLocal upload: local .dat kept as a hot read cache,
+            # but the S3 copy is the durable one — stay read-only even
+            # across restarts (the sidecar IS the durable marker)
+            self.readonly = True
         # Compaction crash recovery. States (commit renames .cpd over
         # .dat FIRST, then .cpx over .idx):
         #   .cpd + .cpx  -> crash before commit: live volume untouched,
@@ -177,6 +190,32 @@ class Volume:
         self.nm = self._load_needle_map()
         return self
 
+    def _load_tiered(self) -> "Volume":
+        """Open a volume whose .dat lives on the S3 tier (sidecar
+        present, no local .dat): data bytes come through ranged GETs,
+        the hot .idx stays local (the reference's tiering split). A
+        tiered volume was sealed before upload, so compaction-crash
+        recovery and tail-integrity repair do not apply; the backend
+        itself refuses writes."""
+        self._dat = backend_mod.open_backend("s3", dat_path(self.base))
+        self.backend_kind = "s3"
+        self.readonly = True
+        head = self._dat.read_at(8, 0)
+        if len(head) < 8:
+            raise VolumeError(f"{self._dat.name} shorter than a "
+                              f"superblock")
+        extra_len = struct.unpack_from(">H", head, 6)[0]
+        self.super_block = SuperBlock.parse(
+            head + self._dat.read_at(extra_len, 8))
+        ip = idx_path(self.base)
+        if not ip.exists():
+            raise VolumeError(
+                f"tiered volume {self.base} has no local .idx — the "
+                f"index stays local when a volume tiers")
+        self._idx = open(ip, "a+b")
+        self.nm = self._load_needle_map()
+        return self
+
     def close(self) -> None:
         for f in (self._dat, self._idx):
             if f is not None:
@@ -198,6 +237,10 @@ class Volume:
         Volume.writeNeedle: append to .dat, then journal to .idx."""
         if self._dat is None:
             raise VolumeError("volume not open")
+        if self.readonly:
+            raise VolumeError(
+                f"volume {self.volume_id} is read-only (tiered copy "
+                f"exists; a local write would silently diverge from it)")
         with self._lock:
             offset = self._dat.size()
             if offset % NEEDLE_PADDING_SIZE:
@@ -247,6 +290,10 @@ class Volume:
         return n
 
     def delete_needle(self, key: int) -> bool:
+        if self.readonly:
+            raise VolumeError(
+                f"volume {self.volume_id} is read-only (tiered copy "
+                f"exists; a local delete would silently diverge from it)")
         with self._lock:
             if not self.nm.delete(key):
                 return False

@@ -1,0 +1,188 @@
+"""S3-tier volume backend: upload, read-through, restart, download.
+
+Closes SURVEY.md §2 row 10's "S3 tier" gap (weed/storage/backend
+s3_backend + shell command_volume_tier_upload/download analogs) using
+the project's OWN loopback S3 gateway as the object store, so the
+whole tier round-trips in-process."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.gateway.s3 import S3Gateway
+from seaweedfs_tpu.shell.commands import CommandEnv, ShellError, run_command
+from seaweedfs_tpu.storage import needle, tier
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(scope="module")
+def gateway(tmp_path_factory):
+    import urllib.request
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=31).start()
+    store = Store([tmp_path_factory.mktemp("gwvol")], max_volumes=8)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    gw = S3Gateway(filer.url, port=_free_port_pair()).start()
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{gw.url}/coldstore", method="PUT"), timeout=10).read()
+    yield gw
+    gw.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture()
+def tiered_store(tmp_path, gateway):
+    """A store with one 40-needle volume tiered to the gateway."""
+    store = Store([tmp_path], max_volumes=4)
+    rng = np.random.default_rng(12)
+    payloads = {i + 1: rng.integers(0, 256, 10_000, dtype=np.uint8)
+                .tobytes() for i in range(40)}
+    try:
+        store.create_volume(3)
+        vol = store.volumes[("", 3)]
+        for nid, data in payloads.items():
+            vol.write_needle(needle.Needle(cookie=9, id=nid, data=data,
+                                           append_at_ns=nid))
+        env = CommandEnv(store=store)
+        run_command(env, f"volume.tier.upload -volumeId 3 "
+                         f"-dest {gateway.url}/coldstore")
+        yield store, env, payloads, gateway
+    finally:
+        store.close()
+
+
+def test_tier_upload_readthrough_and_download(tiered_store, tmp_path):
+    store, env, payloads, gateway = tiered_store
+    base = tmp_path / "3"
+    # local .dat gone, sidecar present, volume re-registered as tiered
+    assert not (tmp_path / "3.dat").exists()
+    assert (tmp_path / "3.tier").exists()
+    vol = store.volumes[("", 3)]
+    assert vol.backend_kind == "s3"
+    # every needle reads back byte-exact through ranged GETs
+    for nid, want in payloads.items():
+        assert vol.read_needle(nid, cookie=9).data == want
+    # tiered volume refuses writes
+    from seaweedfs_tpu.storage.volume import VolumeError
+    with pytest.raises((tier.TierError, VolumeError)):
+        vol.write_needle(needle.Needle(cookie=9, id=99, data=b"x",
+                                       append_at_ns=99))
+    # tier.download restores a writable local volume
+    run_command(env, "volume.tier.download -volumeId 3")
+    assert (tmp_path / "3.dat").exists()
+    assert not (tmp_path / "3.tier").exists()
+    vol2 = store.volumes[("", 3)]
+    assert vol2.backend_kind != "s3"
+    for nid, want in payloads.items():
+        assert vol2.read_needle(nid, cookie=9).data == want
+    vol2.write_needle(needle.Needle(cookie=9, id=99, data=b"writable",
+                                    append_at_ns=99))
+    assert vol2.read_needle(99, cookie=9).data == b"writable"
+
+
+def test_tiered_volume_survives_restart(tiered_store, tmp_path):
+    store, env, payloads, gateway = tiered_store
+    # a fresh Store scan must find the volume via its .tier sidecar
+    store2 = Store([tmp_path], max_volumes=4)
+    store2.load_existing()
+    try:
+        vol = store2.volumes.get(("", 3))
+        assert vol is not None, ".tier sidecar not scanned on restart"
+        assert vol.backend_kind == "s3"
+        some = list(payloads.items())[:5]
+        for nid, want in some:
+            assert vol.read_needle(nid, cookie=9).data == want
+    finally:
+        store2.close()
+
+
+def test_tier_ec_encode_requires_download(tiered_store, tmp_path):
+    """EC encode streams the whole .dat, so a tiered volume points the
+    operator at tier.download instead of hammering ranged GETs; after
+    download the normal seal works."""
+    store, env, payloads, gateway = tiered_store
+    with pytest.raises(ShellError, match="tier.download"):
+        run_command(env, "ec.encode -volumeId 3 -keepSource")
+    run_command(env, "volume.tier.download -volumeId 3")
+    run_command(env, "ec.encode -volumeId 3 -keepSource")
+    assert (tmp_path / "3.ec00").exists()
+    assert (tmp_path / "3.ecx").exists()
+
+
+def test_tier_keep_local_stays_readonly_across_restart(gateway, tmp_path):
+    """-keepLocal: the local .dat remains a hot read cache, but the S3
+    copy is durable — a restart must NOT load the volume writable, or
+    acknowledged writes would silently diverge from the tier."""
+    from seaweedfs_tpu.storage.volume import VolumeError
+    store = Store([tmp_path], max_volumes=4)
+    try:
+        store.create_volume(6)
+        vol = store.volumes[("", 6)]
+        vol.write_needle(needle.Needle(cookie=2, id=1, data=b"cold",
+                                       append_at_ns=1))
+        env = CommandEnv(store=store)
+        run_command(env, f"volume.tier.upload -volumeId 6 "
+                         f"-dest {gateway.url}/coldstore -keepLocal")
+        assert (tmp_path / "6.dat").exists()  # kept
+        assert (tmp_path / "6.tier").exists()
+    finally:
+        store.close()
+    store2 = Store([tmp_path], max_volumes=4)
+    store2.load_existing()
+    try:
+        vol2 = store2.volumes[("", 6)]
+        assert vol2.readonly
+        assert vol2.read_needle(1, cookie=2).data == b"cold"
+        with pytest.raises(VolumeError, match="read-only"):
+            vol2.write_needle(needle.Needle(cookie=2, id=2, data=b"x",
+                                            append_at_ns=2))
+    finally:
+        store2.close()
+    # credentials never persist in the sidecar
+    assert "secret" not in (tmp_path / "6.tier").read_text()
+
+
+def test_tier_sidecar_corruption_detected(tmp_path):
+    (tmp_path / "9.tier").write_text("{not json")
+    with pytest.raises(tier.TierError, match="corrupt"):
+        tier.TierInfo.maybe_load(tmp_path / "9")
+
+
+def test_tier_upload_missing_volume(tmp_path):
+    store = Store([tmp_path], max_volumes=2)
+    env = CommandEnv(store=store)
+    with pytest.raises(ShellError):
+        run_command(env, "volume.tier.upload -volumeId 42 -dest x/y")
