@@ -180,7 +180,14 @@ class Topology:
 
     def reap_dead_nodes(self, timeout: Optional[float] = None) -> list[str]:
         """Drop nodes whose heartbeats stopped (the failure detector)."""
-        timeout = timeout if timeout is not None else 5 * self.pulse_seconds
+        # Floor of 10 s: on a loaded host a healthy server's heartbeat
+        # thread can starve for whole seconds (observed under the
+        # flake-hunt antagonist with pulse 0.2 s: nodes reaped every
+        # few seconds while alive); 5x a sub-second test pulse is
+        # noise, not a death verdict. Production pulse (5 s) keeps its
+        # reference-matching 25 s window.
+        timeout = timeout if timeout is not None \
+            else max(5 * self.pulse_seconds, 10.0)
         now = time.time()
         with self._lock:
             dead = [u for u, n in self.nodes.items()

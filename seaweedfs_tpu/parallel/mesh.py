@@ -127,6 +127,33 @@ def make_sharded_train_step(encoder: Encoder, mesh: Mesh,
     return jax.jit(mapped)
 
 
+def make_sharded_rebuild_step(encoder: Encoder, mesh: Mesh,
+                              present, wanted):
+    """jitted survivors (B, k, S) u8 -> ((B, len(wanted), S) rebuilt,
+    scalar u32 byte-sum checksum psum-reduced over the mesh).
+
+    The sp axis shards the BYTE RANGE of real shard files: the decode
+    matrix application is positionwise over 128-byte groups, so each
+    chip rebuilds its slice of the lost shards from its slice of the
+    survivors with no communication — the cross-chip part is only the
+    integrity psum. ``present`` may be ANY survivor set (uneven mixes
+    of data and parity ids; the first k are used), matching how
+    ec.rebuild reads whichever shards are still alive (SURVEY §3.3)."""
+    rows = encoder.decode_matrix_rows(list(present), list(wanted))
+
+    def step(surv):
+        rebuilt = bitslice.apply_gf_matrix(rows, surv)
+        local = jnp.sum(rebuilt.astype(jnp.uint32), dtype=jnp.uint32)
+        return rebuilt, jax.lax.psum(local, ("dp", "sp"))
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=P("dp", None, "sp"),
+        out_specs=(P("dp", None, "sp"), P()),
+    )
+    return jax.jit(mapped)
+
+
 def shard_batch(x: np.ndarray, mesh: Mesh):
     """Device-put a (B, k, S) batch with (dp, -, sp) sharding; validates
     divisibility (S per chip must stay a multiple of the packing group)."""

@@ -31,6 +31,7 @@ from ..pipeline import encode as encode_mod
 from ..pipeline import rebuild as rebuild_mod
 from ..pipeline.scheme import DEFAULT_SCHEME, EcScheme
 from ..storage import ec_files
+from ..storage.volume import Volume
 from ..storage.store import Store, StoreError, volume_base_name
 
 
@@ -302,6 +303,20 @@ def _volume_base(env: CommandEnv, vid: int, collection: str):
     return None, base
 
 
+def _reopen_volume(env: CommandEnv, vol, base, vid: int,
+                   collection: str) -> None:
+    """Reopen a store-registered volume after a tier move with the
+    STORE's configured kinds (not the closed instance's: a tiered
+    volume's backend_kind says "s3", which would be wrong after a
+    download; the store's is the operator's configuration either way —
+    Volume.load auto-detects the tier sidecar on top of it)."""
+    if vol is None:
+        return
+    env.store.volumes[(collection, vid)] = Volume(
+        base, vid, backend=env.store.backend,
+        needle_map=env.store.needle_map).load()
+
+
 @command("volume.tier.upload")
 def cmd_volume_tier_upload(env: CommandEnv, argv: list[str]) -> None:
     """Move a sealed volume's .dat to an S3 endpoint (the project's own
@@ -331,11 +346,7 @@ def cmd_volume_tier_upload(env: CommandEnv, argv: list[str]) -> None:
             access_key=args.accessKey, secret_key=args.secretKey,
             remove_local=not args.keepLocal)
     finally:
-        if vol is not None:
-            # reopen whatever state the tier move left (tiered or not)
-            env.store.volumes[(args.collection, args.volumeId)] = \
-                type(vol)(base, args.volumeId,
-                          needle_map=vol.needle_map_kind).load()
+        _reopen_volume(env, vol, base, args.volumeId, args.collection)
     env.store.readonly.add((args.collection, args.volumeId))
     env.println(f"volume.tier.upload {args.volumeId}: {info.size} bytes "
                 f"-> {info.endpoint}/{info.bucket}/{info.key}"
@@ -357,10 +368,7 @@ def cmd_volume_tier_download(env: CommandEnv, argv: list[str]) -> None:
     try:
         tier_mod.download_volume_dat(base)
     finally:
-        if vol is not None:
-            env.store.volumes[(args.collection, args.volumeId)] = \
-                type(vol)(base, args.volumeId,
-                          needle_map=vol.needle_map_kind).load()
+        _reopen_volume(env, vol, base, args.volumeId, args.collection)
     env.store.readonly.discard((args.collection, args.volumeId))
     env.println(f"volume.tier.download {args.volumeId}: local again")
 

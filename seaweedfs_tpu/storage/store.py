@@ -132,9 +132,14 @@ class Store:
         for loc in self.locations:
             for col, vid, base in loc.scan_volumes():
                 if (col, vid) not in self.volumes:
-                    self.volumes[(col, vid)] = Volume(
-                        base, vid, backend=self.backend,
-                        needle_map=self.needle_map).load()
+                    vol = Volume(base, vid, backend=self.backend,
+                                 needle_map=self.needle_map).load()
+                    self.volumes[(col, vid)] = vol
+                    if vol.readonly:
+                        # tiered (.tier sidecar): the durable read-only
+                        # marker must survive restarts so heartbeats
+                        # never advertise the volume writable
+                        self.readonly.add((col, vid))
             for col, vid, base, ids in loc.scan_ec_shards():
                 m = self.ec_mounts.setdefault(
                     (col, vid), EcVolumeMount(base, col, vid))

@@ -23,6 +23,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import threading
 import urllib.error
 import urllib.request
 from dataclasses import asdict, dataclass
@@ -114,9 +115,15 @@ class S3TierFile:
     def __init__(self, info: TierInfo, name: str = ""):
         self.info = info
         self.name = name or _object_url(info)
-        #: offset-aligned block -> bytes, LRU by insertion refresh
+        #: offset-aligned block -> bytes, LRU by insertion refresh.
+        #: Guarded by _cache_lock: read_at is called concurrently by
+        #: volume-server reader threads (the Volume drops its lock for
+        #: pread), and OrderedDict eviction racing move_to_end would
+        #: KeyError. The ranged GET itself runs OUTSIDE the lock so a
+        #: slow fetch doesn't serialize unrelated readers.
         self._cache: "collections.OrderedDict[int, bytes]" = \
             collections.OrderedDict()
+        self._cache_lock = threading.Lock()
 
     @classmethod
     def from_dat_path(cls, path: str | Path,
@@ -152,16 +159,18 @@ class S3TierFile:
             raise TierError(f"s3 tier unreachable: {e}") from e
 
     def _block(self, bno: int) -> bytes:
-        blk = self._cache.get(bno)
-        if blk is not None:
-            self._cache.move_to_end(bno)
-            return blk
+        with self._cache_lock:
+            blk = self._cache.get(bno)
+            if blk is not None:
+                self._cache.move_to_end(bno)
+                return blk
         start = bno * BLOCK
         end = min(start + BLOCK, self.info.size)
-        blk = self._fetch(start, end)
-        self._cache[bno] = blk
-        if len(self._cache) > MAX_CACHED_BLOCKS:
-            self._cache.popitem(last=False)
+        blk = self._fetch(start, end)  # outside the lock (slow I/O)
+        with self._cache_lock:
+            self._cache[bno] = blk
+            while len(self._cache) > MAX_CACHED_BLOCKS:
+                self._cache.popitem(last=False)
         return blk
 
     def read_at(self, size: int, offset: int) -> bytes:

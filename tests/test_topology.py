@@ -80,7 +80,7 @@ def test_ec_shard_locations_and_spread():
 def test_dead_node_reaping():
     t = Topology(pulse_seconds=0.01, seed=0)
     node = _hb(t, "h1:8080", volumes=[VolumeInfo(id=1)])
-    node.last_seen -= 10
+    node.last_seen -= 30  # past the 10 s loaded-host floor
     dead = t.reap_dead_nodes()
     assert dead == ["h1:8080"]
     assert t.lookup_volume(1) == []
