@@ -488,6 +488,48 @@ class _VolumeServicer:
         vacuum_mod.abort_compact(vol)
         return volume_server_pb2.VacuumVolumeCleanupResponse()
 
+    # -- cold tier (volume_grpc_tier.go analogs) ------------------------
+
+    def VolumeTierMoveDatToRemote(self, request, context):
+        """Move this server's copy of the volume onto the S3 tier
+        (Store.tier_move: seal -> heartbeat the freeze -> stream while
+        reads keep serving -> reader-drained backend swap). The object
+        key carries this server's identity so replicas of one volume
+        never overwrite each other's tiered copy. Credentials come
+        from the server's environment, never the wire."""
+        import os as os_mod
+
+        store = self.vs.store
+        endpoint, _, bucket = \
+            request.destination_backend_name.rpartition("/")
+        if not endpoint or not bucket:
+            raise VolumeServerError(
+                f"bad destination {request.destination_backend_name!r}; "
+                f"want endpoint/bucket")
+        vol = store.get_volume(request.volume_id, request.collection)
+        info = store.tier_move(
+            request.volume_id, request.collection,
+            endpoint=endpoint, bucket=bucket,
+            object_key=(Path(vol.base).name + "."
+                        + self.vs.url.replace(":", "-") + ".dat"),
+            keep_local=request.keep_local_dat_file,
+            access_key=os_mod.environ.get(
+                "SEAWEEDFS_TPU_TIER_ACCESS_KEY", ""),
+            secret_key=os_mod.environ.get(
+                "SEAWEEDFS_TPU_TIER_SECRET_KEY", ""),
+            on_sealed=self.vs.heartbeat_now)
+        self.vs.heartbeat_now()
+        return volume_server_pb2.VolumeTierMoveDatToRemoteResponse(
+            moved_bytes=info.size,
+            object_url=f"{info.endpoint}/{info.bucket}/{info.key}")
+
+    def VolumeTierMoveDatFromRemote(self, request, context):
+        store = self.vs.store
+        size = store.tier_restore(request.volume_id, request.collection)
+        self.vs.heartbeat_now()
+        return volume_server_pb2.VolumeTierMoveDatFromRemoteResponse(
+            moved_bytes=size)
+
     def VolumeStatus(self, request, context):
         resp = volume_server_pb2.VolumeStatusResponse()
         store = self.vs.store

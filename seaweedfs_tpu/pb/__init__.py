@@ -102,6 +102,12 @@ VOLUME_METHODS = [
     Method("VacuumVolumeCleanup",
            volume_server_pb2.VacuumVolumeCleanupRequest,
            volume_server_pb2.VacuumVolumeCleanupResponse),
+    Method("VolumeTierMoveDatToRemote",
+           volume_server_pb2.VolumeTierMoveDatToRemoteRequest,
+           volume_server_pb2.VolumeTierMoveDatToRemoteResponse),
+    Method("VolumeTierMoveDatFromRemote",
+           volume_server_pb2.VolumeTierMoveDatFromRemoteRequest,
+           volume_server_pb2.VolumeTierMoveDatFromRemoteResponse),
 ]
 
 

@@ -388,6 +388,52 @@ def cmd_volume_list(env: ClusterEnv, argv: list[str]) -> None:
                         f"shards={ShardBits(s.ec_index_bits).ids()}")
 
 
+@cluster_command("volume.tier.upload")
+def cmd_volume_tier_upload(env: ClusterEnv, argv: list[str]) -> None:
+    """Move a volume's .dat to the cold S3 tier on whichever server
+    holds it (command_volume_tier_upload.go choreography over
+    VolumeTierMoveDatToRemote); the server keeps serving reads through
+    ranged GETs and reports the volume read-only from its next
+    heartbeat."""
+    p = _parser("volume.tier.upload")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dest", required=True,
+                   help="endpoint/bucket, e.g. 127.0.0.1:8333/coldstore")
+    p.add_argument("-keepLocal", action="store_true")
+    args = p.parse_args(argv)
+    locs = env.volume_locations(args.volumeId)
+    if not locs:
+        raise ShellError(f"volume {args.volumeId} not found")
+    for url in locs:
+        resp = env.volume(url).VolumeTierMoveDatToRemote(
+            volume_server_pb2.VolumeTierMoveDatToRemoteRequest(
+                volume_id=args.volumeId, collection=args.collection,
+                destination_backend_name=args.dest,
+                keep_local_dat_file=args.keepLocal))
+        env.println(f"volume.tier.upload {args.volumeId} on {url}: "
+                    f"{resp.moved_bytes} bytes -> {resp.object_url}")
+
+
+@cluster_command("volume.tier.download")
+def cmd_volume_tier_download(env: ClusterEnv, argv: list[str]) -> None:
+    """Bring a tiered volume's .dat back to its server's local disk
+    (command_volume_tier_download.go over VolumeTierMoveDatFromRemote)."""
+    p = _parser("volume.tier.download")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    locs = env.volume_locations(args.volumeId)
+    if not locs:
+        raise ShellError(f"volume {args.volumeId} not found")
+    for url in locs:
+        resp = env.volume(url).VolumeTierMoveDatFromRemote(
+            volume_server_pb2.VolumeTierMoveDatFromRemoteRequest(
+                volume_id=args.volumeId, collection=args.collection))
+        env.println(f"volume.tier.download {args.volumeId} on {url}: "
+                    f"{resp.moved_bytes} bytes local again")
+
+
 @cluster_command("volume.vacuum")
 def cmd_volume_vacuum(env: ClusterEnv, argv: list[str]) -> None:
     """Drive Check -> Compact -> Commit on every volume whose reported

@@ -303,25 +303,11 @@ def _volume_base(env: CommandEnv, vid: int, collection: str):
     return None, base
 
 
-def _reopen_volume(env: CommandEnv, vol, base, vid: int,
-                   collection: str) -> None:
-    """Reopen a store-registered volume after a tier move with the
-    STORE's configured kinds (not the closed instance's: a tiered
-    volume's backend_kind says "s3", which would be wrong after a
-    download; the store's is the operator's configuration either way —
-    Volume.load auto-detects the tier sidecar on top of it)."""
-    if vol is None:
-        return
-    env.store.volumes[(collection, vid)] = Volume(
-        base, vid, backend=env.store.backend,
-        needle_map=env.store.needle_map).load()
-
-
 @command("volume.tier.upload")
 def cmd_volume_tier_upload(env: CommandEnv, argv: list[str]) -> None:
-    """Move a sealed volume's .dat to an S3 endpoint (the project's own
+    """Move a volume's .dat to an S3 endpoint (the project's own
     gateway works) and keep serving reads through ranged GETs —
-    command_volume_tier_upload.go over storage/tier.py. The hot .idx
+    command_volume_tier_upload.go over Store.tier_move. The hot .idx
     stays local; the volume becomes read-only until tier.download."""
     from ..storage import tier as tier_mod
     p = _parser("volume.tier.upload")
@@ -338,16 +324,16 @@ def cmd_volume_tier_upload(env: CommandEnv, argv: list[str]) -> None:
         raise ShellError(f"bad -dest {args.dest!r}, want endpoint/bucket")
     vol, base = _volume_base(env, args.volumeId, args.collection)
     if vol is not None:
-        vol.sync()
-        vol.close()
-    try:
+        info = env.store.tier_move(
+            args.volumeId, args.collection, endpoint=endpoint,
+            bucket=bucket, keep_local=args.keepLocal,
+            access_key=args.accessKey, secret_key=args.secretKey)
+    else:
+        # offline base (not registered in the store): move the files
         info = tier_mod.upload_volume_dat(
             base, endpoint, bucket,
             access_key=args.accessKey, secret_key=args.secretKey,
             remove_local=not args.keepLocal)
-    finally:
-        _reopen_volume(env, vol, base, args.volumeId, args.collection)
-    env.store.readonly.add((args.collection, args.volumeId))
     env.println(f"volume.tier.upload {args.volumeId}: {info.size} bytes "
                 f"-> {info.endpoint}/{info.bucket}/{info.key}"
                 + (" (local copy kept)" if args.keepLocal else ""))
@@ -356,7 +342,7 @@ def cmd_volume_tier_upload(env: CommandEnv, argv: list[str]) -> None:
 @command("volume.tier.download")
 def cmd_volume_tier_download(env: CommandEnv, argv: list[str]) -> None:
     """Bring a tiered volume's .dat back to local disk and drop the
-    sidecar (command_volume_tier_download.go)."""
+    sidecar (command_volume_tier_download.go over Store.tier_restore)."""
     from ..storage import tier as tier_mod
     p = _parser("volume.tier.download")
     p.add_argument("-volumeId", type=int, required=True)
@@ -364,12 +350,9 @@ def cmd_volume_tier_download(env: CommandEnv, argv: list[str]) -> None:
     args = p.parse_args(argv)
     vol, base = _volume_base(env, args.volumeId, args.collection)
     if vol is not None:
-        vol.close()
-    try:
+        env.store.tier_restore(args.volumeId, args.collection)
+    else:
         tier_mod.download_volume_dat(base)
-    finally:
-        _reopen_volume(env, vol, base, args.volumeId, args.collection)
-    env.store.readonly.discard((args.collection, args.volumeId))
     env.println(f"volume.tier.download {args.volumeId}: local again")
 
 

@@ -204,6 +204,54 @@ class Store:
     def is_readonly(self, volume_id: int, collection: str = "") -> bool:
         return (collection, volume_id) in self.readonly
 
+    # -- cold tier (storage/tier.py choreography) -------------------------
+
+    def tier_move(self, volume_id: int, collection: str = "", *,
+                  endpoint: str, bucket: str, object_key: str = "",
+                  keep_local: bool = False, access_key: str = "",
+                  secret_key: str = "", on_sealed=None):
+        """Move a volume's .dat to the S3 tier WITHOUT ever taking the
+        volume out of service: seal (read-only; ``on_sealed`` runs so a
+        server can heartbeat the freeze before any byte moves — when
+        the destination is this cluster's own gateway, the upload's
+        chunks must never be assigned to the volume being moved), sync,
+        stream the object while reads keep flowing off the still-open
+        local fd, then retier() swaps the backend under the reader
+        drain. A failed upload rolls the freeze back."""
+        from . import tier as tier_mod
+        key = (collection, volume_id)
+        vol = self.get_volume(volume_id, collection)
+        was_readonly = key in self.readonly
+        self.readonly.add(key)
+        if on_sealed is not None:
+            on_sealed()
+        try:
+            vol.sync()
+            info = tier_mod.upload_volume_dat(
+                vol.base, endpoint, bucket, key=object_key,
+                access_key=access_key, secret_key=secret_key,
+                remove_local=not keep_local)
+        except BaseException:
+            if not was_readonly:
+                self.readonly.discard(key)
+            raise
+        vol.retier()
+        return info
+
+    def tier_restore(self, volume_id: int, collection: str = ""):
+        """Bring a tiered .dat back local and make the volume writable
+        again; a non-tiered volume is a clean error with the volume
+        left untouched (no close/reopen cycle). Credentials resolve
+        from the environment (see tier.TierInfo.maybe_load)."""
+        from . import tier as tier_mod
+        vol = self.get_volume(volume_id, collection)
+        if tier_mod.TierInfo.maybe_load(vol.base) is None:
+            raise StoreError(f"volume {volume_id} is not tiered")
+        tier_mod.download_volume_dat(vol.base)
+        vol.retier()
+        self.readonly.discard((collection, volume_id))
+        return vol.dat_size
+
     def delete_volume(self, volume_id: int, collection: str = "") -> None:
         """Drop the .dat/.idx (ec.encode's final step deletes the source
         volume this way)."""

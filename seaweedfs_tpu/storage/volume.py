@@ -59,6 +59,10 @@ class Volume:
         self.volume_id = volume_id
         self.super_block = super_block or SuperBlock()
         self.backend_kind = backend
+        #: The operator-configured kind, never mutated — backend_kind
+        #: tracks the CURRENT backend ("s3" while tiered) and retier()
+        #: restores this one when the .dat comes back local.
+        self._configured_backend = backend
         self.needle_map_kind = needle_map
         self.nm = CompactMap()
         self._dat: Optional[backend_mod.BackendStorageFile] = None
@@ -215,6 +219,44 @@ class Volume:
         self._idx = open(ip, "a+b")
         self.nm = self._load_needle_map()
         return self
+
+    def retier(self) -> None:
+        """Re-point ``_dat`` at wherever the bytes NOW live (local .dat
+        vs .tier sidecar) after a tier move in either direction, while
+        the volume keeps serving: in-flight readers are drained exactly
+        like the compaction fd swap (new readers park on _no_readers),
+        then the backend handle is swapped under the lock. The needle
+        map and local .idx are untouched — the tier split keeps the
+        index local either way."""
+        from . import tier as tier_mod
+        with self._lock:
+            self._swap_pending = True
+            try:
+                while self._readers:
+                    self._no_readers.wait()
+                old = self._dat
+                p = dat_path(self.base)
+                tiered = tier_mod.TierInfo.maybe_load(self.base) \
+                    is not None
+                if p.exists():
+                    # local bytes (possibly a -keepLocal hot copy)
+                    self._dat = backend_mod.open_backend(
+                        self._configured_backend, p)
+                    self.backend_kind = self._configured_backend
+                    self.readonly = tiered
+                elif tiered:
+                    self._dat = backend_mod.open_backend("s3", p)
+                    self.backend_kind = "s3"
+                    self.readonly = True
+                else:
+                    raise VolumeError(
+                        f"volume {self.volume_id}: neither {p} nor a "
+                        f"tier sidecar exists")
+                if old is not None:
+                    old.close()
+            finally:
+                self._swap_pending = False
+                self._no_readers.notify_all()
 
     def close(self) -> None:
         for f in (self._dat, self._idx):

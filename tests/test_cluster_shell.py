@@ -203,3 +203,62 @@ def test_shell_volume_move_and_collections(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_shell_volume_tier_lifecycle(cluster, tmp_path):
+    """Cluster-mode cold tier: volume.tier.upload moves the .dat to an
+    S3 endpoint via VolumeTierMoveDatToRemote on the owning server,
+    reads keep working through ranged GETs, writes are refused, and
+    volume.tier.download restores local writable state."""
+    import urllib.request
+
+    from seaweedfs_tpu.cluster.filer_server import FilerServer
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.gateway.s3 import S3Gateway
+
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        filer = FilerServer(Filer(), port=_free_port_pair(),
+                            master_url=master.url).start()
+        gw = S3Gateway(filer.url, port=_free_port_pair()).start()
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://{gw.url}/tiercold", method="PUT"),
+                timeout=10).read()
+            rng = np.random.default_rng(8)
+            blobs = [rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+                     for _ in range(6)]
+            fids = operation.submit(mc, blobs)
+            vid = int(fids[0].split(",")[0])
+            keep = [(f, b) for f, b in zip(fids, blobs)
+                    if int(f.split(",")[0]) == vid]
+            _settle(servers)
+
+            env, out = _env(master)
+            run_cluster_command(
+                env, f"volume.tier.upload -volumeId {vid} "
+                     f"-dest {gw.url}/tiercold")
+            assert "bytes ->" in out.getvalue()
+            _settle(servers)
+            # reads ride the tier (download() resolves via the master)
+            for f, b in keep:
+                assert operation.download(mc, f) == b
+            # the tiered volume reports read-only on its server
+            owner = [vs for vs in servers
+                     if ("", vid) in vs.store.volumes]
+            assert owner and all(
+                ("", vid) in vs.store.readonly for vs in owner)
+
+            run_cluster_command(env,
+                                f"volume.tier.download -volumeId {vid}")
+            _settle(servers)
+            for f, b in keep:
+                assert operation.download(mc, f) == b
+            assert all(("", vid) not in vs.store.readonly
+                       for vs in owner)
+        finally:
+            gw.stop()
+            filer.stop()
+    finally:
+        mc.close()
