@@ -600,7 +600,14 @@ def child_core() -> None:
                       ("transpW", _transpW, 4, "w5"),
                       ("swarW64", _swarW64, 4, "w4"),
                       ("transpW", _transpW, 8, "w5"),
-                      ("swarW64", _swarW64, 8, "w4")]
+                      ("swarW64", _swarW64, 8, "w4"),
+                      # n16 reuses each uploaded slab twice per call
+                      # (re-uploading 8 more through the ~24 MiB/s
+                      # tunnel would cost minutes of window for a ~7%
+                      # projected gain); the in-jit fold still forces
+                      # every encode to execute. DEAD LAST: a 2.5 GiB
+                      # arg-set compile failure may only cost tail time.
+                      ("transpW", _transpW, 16, "w5")]
 
     compute_gibps = 0.0
     best_name = None
@@ -627,8 +634,13 @@ def child_core() -> None:
         try:
             fold = _fold_checksum if form == "u8" else _fold_checksum_u32
             fn = _make_folded_fn(gf, coefs, nargs, fold=fold)
-            groups = [tuple(slabs[i:i + nargs])
-                      for i in range(0, n_bufs - nargs + 1, nargs)]
+            if nargs <= len(slabs):
+                groups = [tuple(slabs[i:i + nargs])
+                          for i in range(0, n_bufs - nargs + 1, nargs)]
+            else:  # wider than the upload pool: wrap (slabs repeat
+                # within a call; the fold still runs every encode)
+                groups = [tuple(slabs[j % len(slabs)]
+                                for j in range(nargs))]
             if not groups:
                 raise ValueError(f"need >= {nargs} slabs, have {n_bufs}")
             t, warm_s = _time_folded(fn, groups, passes)

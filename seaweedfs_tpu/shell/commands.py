@@ -159,7 +159,6 @@ def cmd_ec_decode(env: CommandEnv, argv: list[str]) -> None:
                             list(range(scheme.total_shards)),
                             args.collection)
     store.remove_ec_volume_files(args.volumeId, args.collection)
-    from ..storage.volume import Volume
     old = store.volumes.pop((args.collection, args.volumeId), None)
     if old is not None:
         old.close()

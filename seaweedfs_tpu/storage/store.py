@@ -222,6 +222,13 @@ class Store:
         key = (collection, volume_id)
         vol = self.get_volume(volume_id, collection)
         was_readonly = key in self.readonly
+        was_vol_readonly = vol.readonly
+        # Seal under the VOLUME lock: write_needle checks readonly
+        # under the same lock, so every writer either fully landed
+        # before this (its bytes reach the sync below) or fails the
+        # check — none can append between the sync and the upload.
+        with vol._lock:
+            vol.readonly = True
         self.readonly.add(key)
         if on_sealed is not None:
             on_sealed()
@@ -234,6 +241,9 @@ class Store:
         except BaseException:
             if not was_readonly:
                 self.readonly.discard(key)
+            if not was_vol_readonly:
+                with vol._lock:
+                    vol.readonly = False
             raise
         vol.retier()
         return info

@@ -279,11 +279,16 @@ class Volume:
         Volume.writeNeedle: append to .dat, then journal to .idx."""
         if self._dat is None:
             raise VolumeError("volume not open")
-        if self.readonly:
-            raise VolumeError(
-                f"volume {self.volume_id} is read-only (tiered copy "
-                f"exists; a local write would silently diverge from it)")
         with self._lock:
+            # checked UNDER the lock: tier_move seals under this same
+            # lock, so a writer that raced past an outside-the-lock
+            # check could otherwise append after the seal's sync and
+            # lose the needle when the local .dat is dropped
+            if self.readonly:
+                raise VolumeError(
+                    f"volume {self.volume_id} is read-only (tiered "
+                    f"copy exists; a local write would silently "
+                    f"diverge from it)")
             offset = self._dat.size()
             if offset % NEEDLE_PADDING_SIZE:
                 pad = (-offset) % NEEDLE_PADDING_SIZE
@@ -332,11 +337,12 @@ class Volume:
         return n
 
     def delete_needle(self, key: int) -> bool:
-        if self.readonly:
-            raise VolumeError(
-                f"volume {self.volume_id} is read-only (tiered copy "
-                f"exists; a local delete would silently diverge from it)")
         with self._lock:
+            if self.readonly:
+                raise VolumeError(
+                    f"volume {self.volume_id} is read-only (tiered "
+                    f"copy exists; a local delete would silently "
+                    f"diverge from it)")
             if not self.nm.delete(key):
                 return False
             self._idx.write(

@@ -278,3 +278,76 @@ def _s3_get(gw, path):
             return r.read()
     except urllib.error.HTTPError:
         return None
+
+
+def test_walkhold_buffers_and_flushes_in_order():
+    from seaweedfs_tpu.replication.replicator import _WalkHold
+
+    class Rep:
+        def __init__(self):
+            self.applied_paths = []
+            self.last_ts_ns = 0
+
+        def _apply(self, path, new, old):
+            self.applied_paths.append(path)
+
+    import threading
+    rep = Rep()
+    gate = threading.Event()
+    hold = _WalkHold(rep, gate.wait)
+    assert hold.offer("/a", None, None, 5)
+    assert hold.offer("/b", None, None, 7)
+    gate.set()
+    hold.wait(5)
+    # walker flushed the buffer in order and advanced the resume point
+    assert rep.applied_paths == ["/a", "/b"]
+    assert rep.last_ts_ns == 7
+    assert not hold.offer("/c", None, None, 9)  # post-walk: caller applies
+    hold.raise_if_failed()
+
+
+def test_walkhold_overflow_demands_resync_and_drops_nothing_silently():
+    from seaweedfs_tpu.replication.replicator import _WalkHold
+
+    class Rep:
+        last_ts_ns = 0
+
+        def _apply(self, path, new, old):
+            raise AssertionError("overflowed buffer must NOT be applied")
+
+    import threading
+    rep = Rep()
+    gate = threading.Event()
+    cancelled = []
+    hold = _WalkHold(rep, gate.wait, cancel_stream=lambda: cancelled.append(1))
+    hold.MAX_BUFFER = 2  # class attr read via self — shrink for the test
+    hold.offer("/a", None, None, 1)
+    hold.offer("/b", None, None, 2)
+    hold.offer("/c", None, None, 3)  # overflow
+    gate.set()
+    hold.wait(5)
+    assert cancelled, "overflow must cancel the stream to force a re-sync"
+    with pytest.raises(RuntimeError, match="re-sync required"):
+        hold.raise_if_failed()
+
+
+def test_walkhold_failed_walk_cancels_quiet_stream():
+    from seaweedfs_tpu.replication.replicator import _WalkHold
+
+    class Rep:
+        last_ts_ns = 0
+
+        def _apply(self, path, new, old):
+            raise AssertionError("failed walk must not flush")
+
+    cancelled = []
+
+    def bad_walk():
+        raise OSError("source hiccup")
+
+    hold = _WalkHold(Rep(), bad_walk,
+                     cancel_stream=lambda: cancelled.append(1))
+    hold.wait(5)
+    assert cancelled, "a quiet stream would otherwise hide the failure"
+    with pytest.raises(OSError):
+        hold.raise_if_failed()
