@@ -101,6 +101,33 @@ def main() -> int:
         res["probes"].append(probe)
         persist()
 
+    def timed_t(tag: str, rb: int) -> None:
+        """Transpose-kernel rb edge walk (VERDICT r4 item 6: probe2's
+        rb=32 HTTP 500 left the VMEM/block envelope unmapped; rb=16 is
+        the known-good default, so map 20/24/28 before the known-bad).
+        S is the largest multiple of the rb granule under ~16 MiB."""
+        gran = 4 * 32 * rb * 128
+        s = gran * max(1, (16 * MIB) // gran)
+        probe = {"tag": tag, "slab_mib": s / MIB, "rb": rb,
+                 "input_mib": k * s // MIB}
+        try:
+            fn = _make_folded_fn(
+                lambda c, x: rs_pallas.apply_gf_matrix(c, x, rb=rb),
+                coefs, 1)
+            groups = [(jax.device_put(rng.integers(
+                0, 256, size=(1, k, s), dtype=np.uint8)),)
+                for _ in range(2)]
+            t, warm_s = _time_folded(fn, groups, 3)
+            probe["warm_s"] = round(warm_s, 1)
+            probe["gibps"] = round(6 * k * s / GIB / t, 2)
+            print(f"{tag}: rb={rb} -> {probe['gibps']:.2f} GiB/s "
+                  f"(warm {probe['warm_s']}s)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            probe["error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"{tag}: FAILED {probe['error']}", flush=True)
+        res["probes"].append(probe)
+        persist()
+
     # Small blocks first: compile-safe, and the S-intercept separates
     # per-call overhead from per-byte kernel cost for SWAR.
     timed("A.s4.rpb64", 4 * MIB, 64)
@@ -110,6 +137,12 @@ def main() -> int:
     timed("B.2arg", 16 * MIB, 64, nargs=2)
     timed("B.4arg", 16 * MIB, 64, nargs=4)
     timed("B.8arg", 16 * MIB, 64, nargs=8)
+    # transpose rb edge: walk toward probe2's known-bad rb=32 LAST (a
+    # compile failure here is caught per-probe; a helper hang costs
+    # only this bounded child)
+    timed_t("C.rb20", 20)
+    timed_t("C.rb24", 24)
+    timed_t("C.rb28", 28)
     return 0
 
 
