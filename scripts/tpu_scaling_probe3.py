@@ -71,14 +71,18 @@ def main() -> int:
     persist()
 
     def timed(tag: str, s: int, rpb: int, nargs: int = 1,
-              cse: bool = True) -> None:
+              cse: bool = True, kernel=None) -> None:
+        """One timed probe; ``kernel`` overrides the default SWAR
+        lambda (the transpose rb walk reuses this exact harness so
+        every probe row carries the same fields)."""
         probe = {"tag": tag, "slab_mib": s / MIB, "rows_per_block": rpb,
                  "nargs": nargs, "cse": cse,
                  "input_mib": nargs * k * s // MIB}
         try:
-            fn = _make_folded_fn(
+            gf = kernel if kernel is not None else (
                 lambda c, x: rs_pallas.apply_gf_matrix_swar(
-                    c, x, rows_per_block=rpb, cse=cse), coefs, nargs)
+                    c, x, rows_per_block=rpb, cse=cse))
+            fn = _make_folded_fn(gf, coefs, nargs)
             groups = [tuple(jax.device_put(rng.integers(
                         0, 256, size=(1, k, s), dtype=np.uint8))
                     for _ in range(nargs)) for _ in range(2)]
@@ -105,28 +109,12 @@ def main() -> int:
         """Transpose-kernel rb edge walk (VERDICT r4 item 6: probe2's
         rb=32 HTTP 500 left the VMEM/block envelope unmapped; rb=16 is
         the known-good default, so map 20/24/28 before the known-bad).
-        S is the largest multiple of the rb granule under ~16 MiB."""
+        S is the largest multiple of the rb granule under ~16 MiB;
+        rides the SAME timed() harness as the SWAR probes."""
         gran = 4 * 32 * rb * 128
         s = gran * max(1, (16 * MIB) // gran)
-        probe = {"tag": tag, "slab_mib": s / MIB, "rb": rb,
-                 "input_mib": k * s // MIB}
-        try:
-            fn = _make_folded_fn(
-                lambda c, x: rs_pallas.apply_gf_matrix(c, x, rb=rb),
-                coefs, 1)
-            groups = [(jax.device_put(rng.integers(
-                0, 256, size=(1, k, s), dtype=np.uint8)),)
-                for _ in range(2)]
-            t, warm_s = _time_folded(fn, groups, 3)
-            probe["warm_s"] = round(warm_s, 1)
-            probe["gibps"] = round(6 * k * s / GIB / t, 2)
-            print(f"{tag}: rb={rb} -> {probe['gibps']:.2f} GiB/s "
-                  f"(warm {probe['warm_s']}s)", flush=True)
-        except Exception as e:  # noqa: BLE001
-            probe["error"] = f"{type(e).__name__}: {e}"[:200]
-            print(f"{tag}: FAILED {probe['error']}", flush=True)
-        res["probes"].append(probe)
-        persist()
+        timed(tag, s, rpb=rb,
+              kernel=lambda c, x: rs_pallas.apply_gf_matrix(c, x, rb=rb))
 
     # Small blocks first: compile-safe, and the S-intercept separates
     # per-call overhead from per-byte kernel cost for SWAR.

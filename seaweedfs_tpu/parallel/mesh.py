@@ -154,6 +154,78 @@ def make_sharded_rebuild_step(encoder: Encoder, mesh: Mesh,
     return jax.jit(mapped)
 
 
+_auto_mesh: "Mesh | None" = None
+_auto_encode_steps: dict = {}
+
+
+def _make_encode_only_step(encoder: Encoder, mesh: Mesh):
+    """Checksum-free encode for the production batcher: the integrity
+    psum belongs to the verify-style steps, not to every data batch —
+    paying a full-parity reduction plus a both-axes collective per
+    batch would be wasted ICI traffic. On an accelerator the per-shard
+    math is the fused Pallas kernel; elsewhere the XLA network."""
+    from ..ops import rs_jax, rs_pallas
+    coefs = encoder.parity_coefs
+    if rs_jax._use_pallas():
+        def step(x):
+            return rs_pallas.apply_gf_matrix(coefs, x)
+    else:
+        def step(x):
+            return bitslice.apply_gf_matrix(coefs, x)
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=P("dp", None, "sp"),
+        out_specs=P("dp", None, "sp"),
+    )
+    return jax.jit(mapped)
+
+
+def _granule(sp: int) -> int:
+    """Per-shard S granule for the auto-sharded encode: the Pallas
+    kernel needs SEG_BYTES per device shard; the XLA network only the
+    packing group."""
+    from ..ops import rs_jax, rs_pallas
+    return sp * (rs_pallas.SEG_BYTES if rs_jax._use_pallas() else GROUP)
+
+
+def encode_parity_host_sharded(encoder: Encoder, batch: np.ndarray):
+    """Production multi-chip encode: HOST (B, k, S) u8 -> async device
+    (B, m, S) parity (np.asarray materializes it — callers in the
+    3-stage pipeline keep their D2H on the writer thread), computed
+    over a (dp, sp) mesh spanning ALL local devices.
+
+    The batch is padded on the row axis to the dp multiple (zero rows
+    encode to zero parity and are sliced off lazily) and on S to the
+    kernel granule, then sharded (dp, -, sp) — stripe parallelism
+    needs no communication. This is the entry the coalescing batcher
+    uses when more than one device exists (the single-chip tunnel env
+    never takes it; the 8-device CPU mesh in tests and the driver's
+    dryrun do)."""
+    global _auto_mesh
+    if _auto_mesh is None or \
+            _auto_mesh.devices.size != len(jax.devices()):
+        _auto_mesh = make_mesh()
+        _auto_encode_steps.clear()  # steps bake the mesh into shard_map
+    mesh = _auto_mesh
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    b, k, s = batch.shape
+    gran = _granule(sp)
+    b_pad = -(-b // dp) * dp
+    s_pad = -(-s // gran) * gran
+    if b_pad != b or s_pad != s:
+        padded = np.zeros((b_pad, k, s_pad), dtype=np.uint8)
+        padded[:b, :, :s] = batch
+        batch = padded
+    key = (encoder.data_shards, encoder.parity_shards,
+           encoder.parity_coefs.tobytes())
+    step = _auto_encode_steps.get(key)
+    if step is None:
+        step = _make_encode_only_step(encoder, mesh)
+        _auto_encode_steps[key] = step
+    parity = step(shard_batch(batch, mesh))
+    return parity[:b, :, :s]  # lazy device slice; no sync here
+
+
 def shard_batch(x: np.ndarray, mesh: Mesh):
     """Device-put a (B, k, S) batch with (dp, -, sp) sharding; validates
     divisibility (S per chip must stay a multiple of the packing group)."""

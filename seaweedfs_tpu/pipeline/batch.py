@@ -158,9 +158,26 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
                 sink(sp.key, k + j, sp.offset, np.ascontiguousarray(
                     parity[sp.r0:sp.r0 + sp.n, j]))
 
-    pipe.run_pipeline(batches(), scheme.encoder.encode_parity_host,
-                      write)
+    pipe.run_pipeline(batches(), _pick_encode_fn(scheme), write)
     return total
+
+
+def _pick_encode_fn(scheme: EcScheme):
+    """Compute stage for the pipeline: on a multi-chip accelerator the
+    coalesced batches dp/sp-shard over the whole mesh
+    (parallel/mesh.encode_parity_host_sharded — the reference spreads
+    this work over volume servers; the TPU-native form spreads it over
+    chips with one psum of collectives cost). Single-device backends
+    keep the zero-relayout host fast path."""
+    import jax
+
+    from ..ops.rs_jax import _use_pallas
+    if _use_pallas() and len(jax.devices()) > 1:
+        from ..parallel import mesh as mesh_mod
+        enc = scheme.encoder
+        return lambda batch: mesh_mod.encode_parity_host_sharded(
+            enc, batch)
+    return scheme.encoder.encode_parity_host
 
 
 def encode_many(payloads: Sequence[np.ndarray],
