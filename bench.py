@@ -34,7 +34,7 @@ Measurement honesty (see PERF.md):
 Robustness against the intermittent axon TPU tunnel (can hang at backend
 init): the parent imports NO jax. Sub-benches run in SEPARATE watchdogged
 children (core / config3 / config5) and append partial results to
-``artifacts/BENCH_partial_r04.jsonl`` as they complete, so a hang in one
+``artifacts/BENCH_partial_r05.jsonl`` as they complete, so a hang in one
 stage costs only that stage. The parent re-probes between stages and
 falls back per-stage to a scrubbed CPU environment; the final JSON is a
 merge, with per-stage platform markers. This process never exits nonzero.
@@ -60,7 +60,7 @@ CFG5_TIMEOUT = 420
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
-PARTIAL = os.path.join(ARTIFACTS, "BENCH_partial_r04.jsonl")
+PARTIAL = os.path.join(ARTIFACTS, "BENCH_partial_r05.jsonl")
 
 #: Starting per-shard slab length for the headline stream. 16 MiB/shard
 #: = 160 MiB input per call — judge-verified to compile on the axon v5e
@@ -778,7 +778,7 @@ def child_core() -> None:
 
     # optional profiler trace of one pass of the plain encode (never fatal)
     try:
-        trace_dir = os.path.join(ARTIFACTS, "jax_trace_r04")
+        trace_dir = os.path.join(ARTIFACTS, "jax_trace_r05")
         timer = _ChecksumTimer()
         with jax.profiler.trace(trace_dir):
             timer.start()
