@@ -490,3 +490,30 @@ class Encoder:
 
     def reconstruct_data(self, shards: list) -> None:
         self.reconstruct(shards, data_only=True)
+
+    def split(self, data) -> list:
+        """klauspost ``Split``: one buffer -> k data shards (last
+        zero-padded) + m zeroed parity shards, ready for encode()."""
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) else data.astype(np.uint8)
+        if buf.size == 0:
+            raise ShardSizeError("cannot split empty buffer")
+        per = -(-buf.size // self.data_shards)
+        padded = np.zeros(per * self.data_shards, dtype=np.uint8)
+        padded[:buf.size] = buf
+        shards = [padded[i * per:(i + 1) * per].copy()
+                  for i in range(self.data_shards)]
+        shards += [np.zeros(per, dtype=np.uint8)
+                   for _ in range(self.parity_shards)]
+        return shards
+
+    def join(self, shards: Sequence, size: int) -> bytes:
+        """klauspost ``Join``: concatenate the k data shards, trim to
+        ``size``."""
+        if len(shards) < self.data_shards:
+            raise TooFewShardsError("join needs all data shards")
+        cat = np.concatenate([np.asarray(s, dtype=np.uint8)
+                              for s in shards[:self.data_shards]])
+        if cat.size < size:
+            raise ShardSizeError("shards shorter than requested size")
+        return cat[:size].tobytes()

@@ -105,3 +105,22 @@ def test_decode_matrix_cache_reused():
     assert tuple(present[:4]) in enc._decode_cache
     r2 = enc.decode_matrix_rows(present, [0, 5])
     assert np.array_equal(r1[0], r2[0])
+
+
+def test_split_encode_reconstruct_join_roundtrip():
+    """klauspost's canonical flow on the device encoder: Split ->
+    Encode -> lose shards -> Reconstruct -> Join, byte-exact."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops.rs_jax import Encoder
+
+    enc = Encoder(10, 4)
+    payload = np.random.default_rng(7).integers(
+        0, 256, 100_003, dtype=np.uint8).tobytes()
+    shards = enc.split(payload)
+    assert len(shards) == 14
+    enc.encode(shards)
+    for i in (0, 3, 11, 13):
+        shards[i] = None
+    enc.reconstruct(shards)
+    assert enc.join(shards, len(payload)) == payload
