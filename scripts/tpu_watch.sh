@@ -56,6 +56,10 @@ while true; do
       # Bank into TPU_SUCCESS only when the new value beats the banked
       # one (a slow-tunnel rerun must not clobber a better result); stop
       # hunting once the improved (multi-arg / SWAR) headline clears 4.0.
+      # Also: measured kernel promotion — when the equality-gated race
+      # crowns SWAR over transpose by >10% at the same nargs, write
+      # KERNEL_CHOICE.json so production dispatch (ops/rs_jax.py)
+      # adopts the winner without a code change.
       python - "$TS" <<'PYEOF'
 import json, sys
 ts = sys.argv[1]
@@ -69,6 +73,20 @@ if v >= old.get("value", 0):
     json.dump(new, open("artifacts/TPU_SUCCESS", "w"))
 if v >= 4.0:
     json.dump(new, open("artifacts/TPU_SUCCESS2", "w"))
+ex = new.get("extras", {})
+best = {}
+for kern in ("transpW", "swarW64"):
+    vals = [val for key, val in ex.items()
+            if key.startswith(f"headline_{kern}_")
+            and key.endswith("_gibps")
+            and isinstance(val, (int, float))]
+    if vals:
+        best[kern] = max(vals)
+if "swarW64" in best and "transpW" in best:
+    winner = ("swar" if best["swarW64"] > 1.10 * best["transpW"]
+              else "transpose")
+    json.dump({"kernel": winner, "evidence": best, "bench_ts": ts},
+              open("artifacts/KERNEL_CHOICE.json", "w"))
 PYEOF
       if [ -f artifacts/TPU_SUCCESS2 ]; then
         echo "$TS improved TPU result recorded; watcher exiting" >> "$LOG"

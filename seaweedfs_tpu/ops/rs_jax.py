@@ -66,10 +66,30 @@ def _dispatch_mode() -> str:
     return HOST_DISPATCH
 #: Which Pallas kernel the auto "pallas" variant uses: "transpose"
 #: (default — oracle-smoked on hardware every bench round) or "swar"
-#: (transpose-free; see rs_pallas.apply_gf_matrix_swar). Overridable via
-#: the SEAWEEDFS_TPU_KERNEL environment variable so a measured winner
-#: can be promoted without a code change.
-PALLAS_KERNEL = os.environ.get("SEAWEEDFS_TPU_KERNEL", "transpose")
+#: (transpose-free; see rs_pallas.apply_gf_matrix_swar). Resolution
+#: order: SEAWEEDFS_TPU_KERNEL env var > artifacts/KERNEL_CHOICE.json
+#: (written by the bench watcher when a hardware race crowns a winner
+#: by a clear margin — measured promotion without a code change) >
+#: "transpose".
+
+
+def _measured_kernel_default(path=None) -> str:
+    import json as json_mod
+    from pathlib import Path
+    try:
+        p = Path(path) if path is not None else (
+            Path(__file__).resolve().parent.parent.parent
+            / "artifacts" / "KERNEL_CHOICE.json")
+        choice = json_mod.loads(p.read_text()).get("kernel")
+        if choice in ("transpose", "swar"):
+            return choice
+    except Exception:  # noqa: BLE001 — absent/corrupt file = default
+        pass
+    return "transpose"
+
+
+PALLAS_KERNEL = os.environ.get("SEAWEEDFS_TPU_KERNEL") \
+    or _measured_kernel_default()
 
 
 def _kernel() -> str:
