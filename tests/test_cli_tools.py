@@ -73,3 +73,55 @@ def test_benchmark_smoke(cluster, capsys):
          "-size", "512"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["written"] == 20
+
+
+def test_watch_streams_mutations_and_skips_hello(tmp_path):
+    """`weed watch` prints one JSON line per mutation (create/delete)
+    and must NOT emit a line for the stream's hello marker."""
+    import subprocess
+    import sys
+    import threading
+
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+    from seaweedfs_tpu.cluster.filer_server import FilerServer
+    from seaweedfs_tpu.filer import Filer
+
+    fs = FilerServer(Filer(), port=_free_port_pair()).start()
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "watch",
+             "-filer", fs.url, "-pathPrefix", "/w"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        lines: list[str] = []
+
+        def pump():
+            for line in proc.stdout:
+                lines.append(line.strip())
+
+        threading.Thread(target=pump, daemon=True).start()
+        fc = FilerClient(fs.url)
+        try:
+            deadline = time.time() + 30
+            # keep writing until the subprocess's stream (attached at
+            # its own pace) reports an event — each write is a distinct
+            # path so the last-created event always arrives post-attach
+            n = 0
+            while time.time() < deadline and not lines:
+                # namespace-only mutation: no master needed, the meta
+                # event still fires
+                fc.mkdir("/w", f"d{n}")
+                n += 1
+                time.sleep(0.3)
+            assert lines, "watch printed nothing"
+            evs = [json.loads(line) for line in lines if line]
+            assert all(e["event"] in ("create", "update", "delete")
+                       for e in evs), evs
+            assert all(e["path"].startswith("/w/") for e in evs), evs
+        finally:
+            fc.close()
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=10)
+        fs.stop()
