@@ -20,6 +20,7 @@ and compiles to XLA collectives; the same code runs on a virtual CPU mesh
 
 from __future__ import annotations
 
+import collections
 import math
 from typing import Optional
 
@@ -35,6 +36,17 @@ from ..ops.rs_jax import Encoder
 GROUP = bitslice.GROUP_BYTES
 
 
+def _auto_factor(n: int) -> tuple[int, int]:
+    """Most-square (dp, sp) with sp >= dp (stripe parallelism is
+    communication-free here, so over-sharding it is harmless)."""
+    dp = 1
+    for f in range(int(math.isqrt(n)), 0, -1):
+        if n % f == 0:
+            dp = f
+            break
+    return dp, n // dp
+
+
 def make_mesh(devices=None, dp: Optional[int] = None,
               sp: Optional[int] = None) -> Mesh:
     """Build a (dp, sp) mesh over the given devices (default: all).
@@ -46,12 +58,7 @@ def make_mesh(devices=None, dp: Optional[int] = None,
     devices = list(jax.devices()) if devices is None else list(devices)
     n = len(devices)
     if dp is None and sp is None:
-        dp = 1
-        for f in range(int(math.isqrt(n)), 0, -1):
-            if n % f == 0:
-                dp = f
-                break
-        sp = n // dp
+        dp, sp = _auto_factor(n)
     elif dp is None:
         if n % sp:
             raise ValueError(f"sp={sp} does not divide device count {n}")
@@ -154,19 +161,24 @@ def make_sharded_rebuild_step(encoder: Encoder, mesh: Mesh,
     return jax.jit(mapped)
 
 
-_auto_mesh: "Mesh | None" = None
-_auto_encode_steps: dict = {}
+_auto_meshes: dict = {}       # dp-choice -> Mesh over all devices
+_auto_n_devices = 0
+#: (mesh shape, coefs shape, coefs bytes) -> jitted step; LRU-bounded —
+#: rebuilds mint one decode matrix per loss pattern, and a long-lived
+#: repair daemon must not accumulate an executable per pattern forever.
+_auto_steps: "collections.OrderedDict" = collections.OrderedDict()
+_AUTO_STEPS_CAP = 32
 
 
-def _make_encode_only_step(encoder: Encoder, mesh: Mesh):
-    """Checksum-free encode for the production batcher: the integrity
+def _make_apply_only_step(coefs: np.ndarray, mesh: Mesh):
+    """Checksum-free coefficient-rows application for the production
+    paths (encode: parity rows; rebuild: decode rows): the integrity
     psum belongs to the verify-style steps, not to every data batch —
-    paying a full-parity reduction plus a both-axes collective per
-    batch would be wasted ICI traffic. On an accelerator the per-shard
-    math is the fused Pallas kernel; elsewhere the XLA network."""
-    from ..ops import rs_jax, rs_pallas
-    coefs = encoder.parity_coefs
-    if rs_jax._use_pallas():
+    paying a full reduction plus a both-axes collective per batch would
+    be wasted ICI traffic. On an accelerator the per-shard math is the
+    fused Pallas kernel; elsewhere the XLA network."""
+    from ..ops import rs_pallas
+    if _real_accelerator():
         def step(x):
             return rs_pallas.apply_gf_matrix(coefs, x)
     else:
@@ -180,50 +192,90 @@ def _make_encode_only_step(encoder: Encoder, mesh: Mesh):
     return jax.jit(mapped)
 
 
+def _real_accelerator() -> bool:
+    """The REAL backend decides kernel + granule (Mosaic only lowers on
+    TPU) — deliberately decoupled from rs_jax._use_pallas, which the
+    routing gates (and their tests) may override."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def _granule(sp: int) -> int:
     """Per-shard S granule for the auto-sharded encode: the Pallas
     kernel needs SEG_BYTES per device shard; the XLA network only the
-    packing group."""
-    from ..ops import rs_jax, rs_pallas
-    return sp * (rs_pallas.SEG_BYTES if rs_jax._use_pallas() else GROUP)
+    packing group. Follows the REAL backend, like the step kernel."""
+    from ..ops import rs_pallas
+    return sp * (rs_pallas.SEG_BYTES if _real_accelerator() else GROUP)
 
 
-def encode_parity_host_sharded(encoder: Encoder, batch: np.ndarray):
-    """Production multi-chip encode: HOST (B, k, S) u8 -> async device
-    (B, m, S) parity (np.asarray materializes it — callers in the
-    3-stage pipeline keep their D2H on the writer thread), computed
-    over a (dp, sp) mesh spanning ALL local devices.
+def _apply_host_sharded(coefs: np.ndarray, batch: np.ndarray):
+    """Apply coefficient rows to a HOST (B, n_in, S) u8 batch over a
+    mesh spanning ALL local devices; returns an async device
+    (B, n_out, S) result (np.asarray materializes it — callers in the
+    3-stage pipeline keep their D2H on the writer thread).
 
-    The batch is padded on the row axis to the dp multiple (zero rows
-    encode to zero parity and are sliced off lazily) and on S to the
-    kernel granule, then sharded (dp, -, sp) — stripe parallelism
-    needs no communication. This is the entry the coalescing batcher
-    uses when more than one device exists (the single-chip tunnel env
-    never takes it; the 8-device CPU mesh in tests and the driver's
-    dryrun do)."""
-    global _auto_mesh
-    if _auto_mesh is None or \
-            _auto_mesh.devices.size != len(jax.devices()):
-        _auto_mesh = make_mesh()
-        _auto_encode_steps.clear()  # steps bake the mesh into shard_map
-    mesh = _auto_mesh
-    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
-    b, k, s = batch.shape
+    Mesh shape adapts to the batch: small B (the rebuild path streams
+    B=1 chunks) takes an sp-only mesh so every device holds a stripe
+    slice instead of (dp-1)/dp of them computing zero padding. The
+    batch is padded on the row axis to the dp multiple and on S to the
+    kernel granule (zero rows/columns map to zero output, sliced off
+    lazily), then sharded (dp, -, sp) — stripe parallelism needs no
+    communication."""
+    global _auto_n_devices
+    n_dev = len(jax.devices())
+    if _auto_n_devices != n_dev:
+        _auto_meshes.clear()
+        _auto_steps.clear()  # steps bake their mesh into shard_map
+        _auto_n_devices = n_dev
+    b, _n_in, s = batch.shape
+    dp_auto, _ = _auto_factor(n_dev)
+    dp = dp_auto if b >= dp_auto else 1
+    mesh = _auto_meshes.get(dp)
+    if mesh is None:
+        mesh = make_mesh(dp=dp)
+        _auto_meshes[dp] = mesh
+    sp = mesh.shape["sp"]
     gran = _granule(sp)
     b_pad = -(-b // dp) * dp
     s_pad = -(-s // gran) * gran
     if b_pad != b or s_pad != s:
-        padded = np.zeros((b_pad, k, s_pad), dtype=np.uint8)
+        padded = np.zeros((b_pad, _n_in, s_pad), dtype=np.uint8)
         padded[:b, :, :s] = batch
         batch = padded
-    key = (encoder.data_shards, encoder.parity_shards,
-           encoder.parity_coefs.tobytes())
-    step = _auto_encode_steps.get(key)
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    key = (dp, sp, coefs.shape, coefs.tobytes())
+    step = _auto_steps.get(key)
     if step is None:
-        step = _make_encode_only_step(encoder, mesh)
-        _auto_encode_steps[key] = step
-    parity = step(shard_batch(batch, mesh))
-    return parity[:b, :, :s]  # lazy device slice; no sync here
+        step = _make_apply_only_step(coefs, mesh)
+        _auto_steps[key] = step
+        while len(_auto_steps) > _AUTO_STEPS_CAP:
+            _auto_steps.popitem(last=False)
+    else:
+        _auto_steps.move_to_end(key)
+    out = step(shard_batch(batch, mesh))
+    return out[:b, :, :s]  # lazy device slice; no sync here
+
+
+def encode_parity_host_sharded(encoder: Encoder, batch: np.ndarray):
+    """Production multi-chip encode: HOST (B, k, S) u8 -> async
+    (B, m, S) parity over all local devices. This is the entry the
+    coalescing batcher uses when more than one device exists (the
+    single-chip tunnel env never takes it; the 8-device CPU mesh in
+    tests and the driver's dryrun do)."""
+    return _apply_host_sharded(encoder.parity_coefs, batch)
+
+
+def reconstruct_host_sharded(encoder: Encoder, survivors: np.ndarray,
+                             present, wanted):
+    """Production multi-chip rebuild: decode rows for (present ->
+    wanted) applied to HOST survivor chunks over the whole mesh — the
+    multi-device form of reconstruct_batch_host that the rebuild
+    pipeline uses when more than one device exists. ``survivors``:
+    (B, len(present), S) u8, first k used."""
+    rows = encoder.decode_matrix_rows(list(present), list(wanted))
+    chosen = survivors[:, :encoder.data_shards, :]
+    if not chosen.flags.c_contiguous:
+        chosen = np.ascontiguousarray(chosen)
+    return _apply_host_sharded(rows, chosen)
 
 
 def shard_batch(x: np.ndarray, mesh: Mesh):

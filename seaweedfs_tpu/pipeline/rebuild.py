@@ -52,6 +52,7 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     # Only the first k survivors feed the decode matrix — don't read the
     # rest from disk at all.
     present = present[:scheme.data_shards]
+    reconstruct = _pick_reconstruct_fn(scheme, present, missing)
     ins = [open(ec_files.shard_path(base, i), "rb") for i in present]
     outs = [open(ec_files.shard_path(base, i), "wb") for i in missing]
     try:
@@ -60,8 +61,7 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
             take = min(chunk_bytes, size - pos)
             chunk = np.stack([
                 np.frombuffer(f.read(take), dtype=np.uint8) for f in ins])
-            rebuilt = np.asarray(scheme.encoder.reconstruct_batch_host(
-                chunk[None], present, missing))[0]
+            rebuilt = np.asarray(reconstruct(chunk[None]))[0]
             for row, f in zip(rebuilt, outs):
                 row.tofile(f)
             pos += take
@@ -69,3 +69,20 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         for f in ins + outs:
             f.close()
     return missing
+
+
+def _pick_reconstruct_fn(scheme: EcScheme, present, missing):
+    """On a multi-chip accelerator the rebuild chunks shard over the
+    whole mesh (parallel/mesh.reconstruct_host_sharded); single-device
+    backends keep the host fast path — same routing rule as the
+    batcher's encode (pipeline/batch._pick_encode_fn)."""
+    import jax
+
+    from ..ops.rs_jax import _use_pallas
+    enc = scheme.encoder
+    if _use_pallas() and len(jax.devices()) > 1:
+        from ..parallel import mesh as mesh_mod
+        return lambda chunk: mesh_mod.reconstruct_host_sharded(
+            enc, chunk, present, missing)
+    return lambda chunk: enc.reconstruct_batch_host(
+        chunk, present, missing)
