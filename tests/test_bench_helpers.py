@@ -44,3 +44,14 @@ def test_fold_checksums_agree_across_forms():
     np.testing.assert_array_equal(ck_u8, ck_w4)
     np.testing.assert_array_equal(ck_u8, ck_w5)
     assert ck_u8.shape == (8, 128) and ck_u8.dtype == np.uint32
+
+
+def test_fast_tmpdir_capacity_gate():
+    import bench
+
+    # absurd requirement -> must refuse shm rather than ENOSPC later
+    assert bench._fast_tmpdir(need_bytes=1 << 60) is None
+    # tiny requirement -> shm accepted where it exists
+    import os
+    if os.path.isdir("/dev/shm"):
+        assert bench._fast_tmpdir(need_bytes=1 << 20) == "/dev/shm"
