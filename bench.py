@@ -231,7 +231,11 @@ def parent() -> None:
                 "note": "real-TPU benchmark banked by scripts/"
                         "tpu_watch.sh during an earlier tunnel window "
                         "(artifacts/TPU_SUCCESS); this run's chip "
-                        "access degraded",
+                        "access degraded. Fields reflect the bench AS "
+                        "OF BANKING — a pre-round-5 bank predates the "
+                        "hybrid dispatch policy (its repair_* fields "
+                        "show the old all-device config-5), the "
+                        "word-form race, and the GFNI CPU baseline",
             }
         except (OSError, ValueError):
             pass
