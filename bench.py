@@ -774,6 +774,40 @@ def child_core() -> None:
                 f"bytes OK, executable {d_gibps:.2f} GiB/s"
                 + (f" ({100 * res['dispatch_vs_race_frac']:.0f}% of "
                    f"raced transpW)" if race_ref else ""))
+            # grouped production dispatch (apply_matrix_host_multi's
+            # executable): n slab args per call, the production analog
+            # of the raced transpW_n16 candidate. Reuses each uploaded
+            # slab twice per call exactly like the race did.
+            ng = min(16, 2 * len(w5))
+            fnm = rs_jax_mod._jitted_apply_multi(
+                coefs.tobytes(), m, k, "pallas_words", ng)
+            grp = tuple(w5[i % len(w5)] for i in range(ng))
+            ys = fnm(*grp)  # warm (compile)
+            # bytes check: grouped outputs == the single-dispatch
+            # executable's outputs for the same slabs (slice on device;
+            # fetching whole parities would drag MiBs through the link)
+            for j in (0, ng - 1):
+                want_j = fnp(grp[j])
+                if not np.array_equal(np.asarray(ys[j][..., :1]),
+                                      np.asarray(want_j[..., :1])):
+                    raise AssertionError(
+                        f"grouped dispatch output {j} != single path")
+            t0 = time.perf_counter()
+            y = None
+            for _ in range(passes):
+                y = fnm(*grp)
+            np.asarray(y[-1][..., :1])
+            t_m = time.perf_counter() - t0
+            m_gibps = passes * ng * per_call / GIB / t_m
+            res["dispatch_multi_gibps"] = round(m_gibps, 3)
+            res["dispatch_multi_nargs"] = ng
+            if race_ref:
+                res["dispatch_multi_vs_race_frac"] = round(
+                    m_gibps / race_ref, 3)
+            log(f"grouped production dispatch (n={ng}): "
+                f"{m_gibps:.2f} GiB/s"
+                + (f" ({100 * res['dispatch_multi_vs_race_frac']:.0f}% "
+                   f"of raced transpW)" if race_ref else ""))
         except Exception as e:  # noqa: BLE001 — smoke must not kill core
             res["dispatch_path_ok"] = False
             res["dispatch_path_error"] = f"{type(e).__name__}: {e}"[:200]

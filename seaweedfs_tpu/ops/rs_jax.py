@@ -51,9 +51,32 @@ FORCE: Optional[str] = None
 #: "device" / "native" pin the choice (the bench pins "device" to smoke
 #: the production device path regardless of the link).
 HOST_DISPATCH = os.environ.get("SEAWEEDFS_TPU_HOST_DISPATCH", "auto")
+#: How many equally-shaped host slabs one device dispatch may carry on
+#: the word-form path (apply_matrix_host_multi). The round-5 hardware
+#: race measured the per-dispatch launch+sync floor dominating
+#: single-slab calls (160 MiB/call -> ~4 GiB/s) while 16 slab-sized
+#: args in ONE jitted call ran the same kernel at 119 GiB/s; the
+#: remote-compile ceiling is per-BUFFER, not per-program (PERF.md), so
+#: grouping scales throughput without approaching the compile limit.
+DISPATCH_GROUP = os.environ.get("SEAWEEDFS_TPU_DISPATCH_GROUP", "16")
 _link_gibps: Optional[float] = None
 _native_gibps: Optional[float] = None
 _calibrate_lock = threading.Lock()
+
+
+def _dispatch_group() -> int:
+    """Validated DISPATCH_GROUP, checked at use time (same rationale as
+    _kernel(): a typo'd env var must surface as a normal error from the
+    encode call, not an import-time traceback)."""
+    try:
+        g = int(DISPATCH_GROUP)
+    except (TypeError, ValueError):
+        g = -1
+    if g < 1:
+        raise ValueError(
+            f"SEAWEEDFS_TPU_DISPATCH_GROUP={DISPATCH_GROUP!r}: expected "
+            f"a positive integer")
+    return g
 
 
 def _dispatch_mode() -> str:
@@ -229,6 +252,30 @@ def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
     return apply_fn
 
 
+@functools.lru_cache(maxsize=64)
+def _jitted_apply_multi(coefs_bytes: bytes, n_out: int, n_in: int,
+                        variant: str, nargs: int):
+    """One jitted executable per (coefficient matrix, words variant,
+    group width): nargs word-form slabs in, nargs parities out. One
+    dispatch for the whole group — the production analog of the bench
+    race's n16 candidate (PERF.md: the launch+sync floor, not the
+    kernel, dominates single-slab calls)."""
+    coefs = np.frombuffer(coefs_bytes, dtype=np.uint8).reshape(n_out, n_in)
+    if variant == "pallas_swar_words":
+        def kern(x):
+            return rs_pallas.apply_gf_matrix_swar_words(coefs, x)
+    else:
+        def kern(x):
+            return rs_pallas.apply_gf_matrix_words(coefs, x)
+
+    @jax.jit
+    def apply_fn(*xs):
+        assert len(xs) == nargs
+        return tuple(kern(x) for x in xs)
+
+    return apply_fn
+
+
 class _HostParity:
     """Async device parity held in word form; ``np.asarray`` (the
     pipeline writer's sync point) fetches it and re-views the bytes as
@@ -263,36 +310,155 @@ def apply_matrix_host(coefs: np.ndarray, batch):
     apply_matrix."""
     coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
     n_out, n_in = coefs.shape
-    lanes = rs_pallas.LANES
-    if (isinstance(batch, np.ndarray) and batch.ndim == 3
-            and batch.dtype == np.uint8
-            and batch.flags.c_contiguous and FORCE is None
-            and batch.shape[1] == n_in
-            # one dispatch predicate for all call sites
-            and _pick_variant(batch.shape[-1])
-            in ("pallas", "pallas_swar")):
-        if not _device_worth_it() and rs_native.available():
+    wf = _host_word_form(n_in, batch)
+    if wf is not None:
+        if _stay_on_host():
             # link slower than the host codec: crossing can only lose.
             # (Pinned "native" without a built codec falls through to
             # the device leg instead of crashing.)
-            y = rs_native.apply_gf_matrix(coefs, batch)
-            return y
+            return rs_native.apply_gf_matrix(coefs, batch)
+        variant, xw = wf
         b, _, s = batch.shape
-        w = s // 4
-        coefs_b = coefs.tobytes()
-        if _kernel() == "swar" and rs_pallas.swar_conforms(s):
-            x = jnp.asarray(batch.view(np.uint32).reshape(
-                b, n_in, w // lanes, lanes))
-            fn = _jitted_apply(coefs_b, n_out, n_in,
-                               "pallas_swar_words")
-            return _HostParity(fn(x), b, n_out, s)
-        if _kernel() != "swar" and rs_pallas.conforms(s):
-            x = jnp.asarray(batch.view(np.uint32).reshape(
-                b, n_in, rs_pallas.GROUP_WORDS,
-                w // (rs_pallas.GROUP_WORDS * lanes), lanes))
-            fn = _jitted_apply(coefs_b, n_out, n_in, "pallas_words")
-            return _HostParity(fn(x), b, n_out, s)
+        fn = _jitted_apply(coefs.tobytes(), n_out, n_in, variant)
+        return _HostParity(fn(jnp.asarray(xw)), b, n_out, s)
+    if _host_prefers_native(n_in, batch):
+        return rs_native.apply_gf_matrix(coefs, batch)
     return apply_matrix(coefs, batch)
+
+
+def _host_eligible(n_in: int, batch) -> bool:
+    """THE host-slab device-dispatch eligibility rule, shared by
+    _host_word_form and _host_prefers_native: HOST-contiguous
+    (B, n_in, S) uint8 with a Pallas-eligible S."""
+    return (isinstance(batch, np.ndarray) and batch.ndim == 3
+            and batch.dtype == np.uint8 and batch.flags.c_contiguous
+            and FORCE is None and batch.shape[1] == n_in
+            and _pick_variant(batch.shape[-1])
+            in ("pallas", "pallas_swar"))
+
+
+def _stay_on_host() -> bool:
+    """Hybrid rule, spelled once: large host slabs stay on the host
+    when the link can't outrun the host codec (and the codec exists)."""
+    return not _device_worth_it() and rs_native.available()
+
+
+def _host_prefers_native(n_in: int, batch) -> bool:
+    """Slow-link guard for host slabs that are Pallas-ELIGIBLE but not
+    word-form-CONFORMING (e.g. arbitrary-length tail chunks): crossing
+    the device link through apply_matrix's padded u8 path can only lose
+    when the link is slower than the host codec, so they take the
+    native leg — the same hybrid rule conforming slabs get."""
+    return _host_eligible(n_in, batch) and _stay_on_host()
+
+
+def host_dispatch_group() -> int:
+    """Group width for the host-slab pipelines (ONE policy for encode,
+    the coalescing batcher and rebuild): >1 only on a single-device
+    accelerator backend — multi-chip paths mesh-shard each batch
+    instead (parallel/mesh), and CPU backends never take the word-form
+    device path."""
+    if not _use_pallas() or len(jax.devices()) > 1:
+        return 1
+    return _dispatch_group()
+
+
+def _host_word_form(n_in: int, batch):
+    """Eligibility + zero-copy word view for the device fast path.
+
+    Returns (variant, words_view) when ``batch`` can ride the
+    zero-relayout word-form dispatch — HOST-contiguous (B, n_in, S)
+    uint8, Pallas-eligible S, kernel-conforming shape — else None.
+    One predicate shared by the single and grouped call sites."""
+    if not _host_eligible(n_in, batch):
+        return None
+    b, _, s = batch.shape
+    w = s // 4
+    lanes = rs_pallas.LANES
+    if _kernel() == "swar" and rs_pallas.swar_conforms(s):
+        return "pallas_swar_words", batch.view(np.uint32).reshape(
+            b, n_in, w // lanes, lanes)
+    if _kernel() != "swar" and rs_pallas.conforms(s):
+        return "pallas_words", batch.view(np.uint32).reshape(
+            b, n_in, rs_pallas.GROUP_WORDS,
+            w // (rs_pallas.GROUP_WORDS * lanes), lanes)
+    return None
+
+
+def apply_matrix_host_multi(coefs: np.ndarray, batches):
+    """Grouped apply_matrix_host: a list of HOST (B, n_in, S) uint8
+    slabs -> a list of async results in the same order.
+
+    Runs of adjacent, identically-shaped, fast-path-eligible slabs are
+    dispatched as ONE jitted call with up to ``_dispatch_group()`` slab
+    args (_jitted_apply_multi), amortizing the per-dispatch launch+sync
+    floor that leaves single-slab calls ~25x under the same kernel's
+    grouped throughput (round-5 race: 4.3 -> 119 GiB/s at n=16).
+    Ineligible or odd-shaped slabs fall back to the single-slab paths;
+    a shape change or a full group flushes, and a flushed run is split
+    into power-of-two sub-dispatches — so the jit cache sees at most
+    log2(group) (shape, width) pairs per workload, never a retrace
+    storm (the pipeline's greedy drain yields arbitrary run lengths)."""
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    n_out, n_in = coefs.shape
+    out: list = [None] * len(batches)
+    cap = _dispatch_group()
+    stay_host: Optional[bool] = None
+    g_ix: list[int] = []
+    g_xw: list = []
+    g_shape = g_variant = None
+
+    def dispatch(ixs, xws, width):
+        if width == 1:
+            # lone slab: the single-dispatch executable (already cached
+            # for steady-state workloads) serves the word form the loop
+            # already built
+            i = ixs[0]
+            b, _, s = batches[i].shape
+            fn = _jitted_apply(coefs.tobytes(), n_out, n_in, g_variant)
+            out[i] = _HostParity(fn(jnp.asarray(xws[0])), b, n_out, s)
+            return
+        fn = _jitted_apply_multi(coefs.tobytes(), n_out, n_in,
+                                 g_variant, width)
+        ys = fn(*[jnp.asarray(x) for x in xws])
+        for i, y in zip(ixs, ys):
+            b, _, s = batches[i].shape
+            out[i] = _HostParity(y, b, n_out, s)
+
+    def flush():
+        nonlocal g_ix, g_xw, g_shape, g_variant
+        # quantize to power-of-two widths (13 -> 8+4+1) so executables
+        # are shared across the drain's arbitrary run lengths
+        pos = 0
+        while pos < len(g_ix):
+            width = 1 << ((len(g_ix) - pos).bit_length() - 1)
+            dispatch(g_ix[pos:pos + width], g_xw[pos:pos + width], width)
+            pos += width
+        g_ix, g_xw, g_shape, g_variant = [], [], None, None
+
+    for i, batch in enumerate(batches):
+        wf = _host_word_form(n_in, batch)
+        if wf is None:
+            flush()
+            out[i] = (rs_native.apply_gf_matrix(coefs, batch)
+                      if _host_prefers_native(n_in, batch)
+                      else apply_matrix(coefs, batch))
+            continue
+        if stay_host is None:
+            stay_host = _stay_on_host()
+        if stay_host:
+            flush()
+            out[i] = rs_native.apply_gf_matrix(coefs, batch)
+            continue
+        variant, xw = wf
+        if g_ix and (batch.shape != g_shape or variant != g_variant
+                     or len(g_ix) >= cap):
+            flush()
+        g_ix.append(i)
+        g_xw.append(xw)
+        g_shape, g_variant = batch.shape, variant
+    flush()
+    return out
 
 
 def apply_matrix(coefs: np.ndarray, x) -> "np.ndarray | jnp.ndarray":
@@ -390,6 +556,14 @@ class Encoder:
         apply_matrix_host."""
         return apply_matrix_host(self.matrix[self.data_shards:], batch)
 
+    def encode_parity_host_multi(self, batches):
+        """Grouped pipeline fast path: a list of HOST (B, k, S) uint8
+        slabs -> a list of async parities, dispatching runs of
+        same-shaped slabs as ONE device call (apply_matrix_host_multi)
+        to amortize the per-dispatch floor."""
+        return apply_matrix_host_multi(self.matrix[self.data_shards:],
+                                       batches)
+
     def reconstruct_batch_host(self, shards, present: Sequence[int],
                                wanted: Optional[Sequence[int]] = None):
         """reconstruct_batch for HOST survivor arrays — rides the
@@ -401,6 +575,24 @@ class Encoder:
                 and not chosen.flags.c_contiguous):
             chosen = np.ascontiguousarray(chosen)
         return apply_matrix_host(rows, chosen)
+
+    def reconstruct_batch_host_multi(self, chunks,
+                                     present: Sequence[int],
+                                     wanted: Optional[Sequence[int]]
+                                     = None):
+        """Grouped reconstruct_batch_host: a list of HOST
+        (B, len(present), S) uint8 chunks sharing one survivor set ->
+        a list of async rebuilt shards, with runs of same-shaped chunks
+        dispatched as one device call (apply_matrix_host_multi)."""
+        rows = self._decode_rows_for(present, wanted)
+        prepared = []
+        for c in chunks:
+            chosen = c[:, :self.data_shards, :]
+            if (isinstance(chosen, np.ndarray)
+                    and not chosen.flags.c_contiguous):
+                chosen = np.ascontiguousarray(chosen)
+            prepared.append(chosen)
+        return apply_matrix_host_multi(rows, prepared)
 
     def _decode_rows_for(self, present: Sequence[int],
                          wanted: Optional[Sequence[int]]) -> np.ndarray:

@@ -158,7 +158,15 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
                 sink(sp.key, k + j, sp.offset, np.ascontiguousarray(
                     parity[sp.r0:sp.r0 + sp.n, j]))
 
-    pipe.run_pipeline(batches(), _pick_encode_fn(scheme), write)
+    # Grouped dispatch on a single accelerator (one shared policy —
+    # pipe.pick_grouped_dispatch): runs of same-shaped coalesced
+    # batches share one device call (the buckets emit equal shapes
+    # until the tail, so steady state groups fully); multi-chip keeps
+    # per-batch mesh sharding via _pick_encode_fn.
+    multi, group, max_batch_bytes = pipe.pick_grouped_dispatch(
+        scheme.encoder.encode_parity_host_multi, max_batch_bytes)
+    pipe.run_pipeline(batches(), _pick_encode_fn(scheme), write,
+                      encode_multi_fn=multi, group=group)
     return total
 
 

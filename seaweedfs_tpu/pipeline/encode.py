@@ -59,6 +59,12 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     dat = np.memmap(datp, dtype=np.uint8, mode="r") \
         if datp.stat().st_size else np.zeros(0, dtype=np.uint8)
     k = scheme.data_shards
+    # Grouped dispatch on a single accelerator: several smaller batches
+    # ride one device call (rs_jax.apply_matrix_host_multi), amortizing
+    # the per-dispatch floor that caps single-slab calls ~25x below the
+    # same kernel's grouped throughput (PERF.md round-5 race).
+    encode_multi, group, max_batch_bytes = pipe.pick_grouped_dispatch(
+        scheme.encoder.encode_parity_host_multi, max_batch_bytes)
     outs = [open(ec_files.shard_path(base, i), "wb")
             for i in range(scheme.total_shards)]
 
@@ -78,7 +84,8 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
 
     try:
         pipe.run_pipeline(batches(), scheme.encoder.encode_parity_host,
-                          write)
+                          write, encode_multi_fn=encode_multi,
+                          group=group)
     finally:
         for f in outs:
             f.close()

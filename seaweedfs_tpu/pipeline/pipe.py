@@ -33,7 +33,31 @@ import numpy as np
 #: Stage-queue depth: 2 = classic double buffering.
 DEPTH = 2
 
+#: Bound on one batch's INPUT bytes while grouped dispatch is active:
+#: the pipeline queues then hold up to `group` batches each, so the
+#: per-batch size shrinks to keep host memory and the ~160 MiB
+#: per-buffer remote-compile ceiling (PERF.md) bounded while one
+#: dispatch still carries group x this.
+GROUPED_BATCH_BYTES = 64 * 1024 * 1024
+
 _END = object()
+
+
+def pick_grouped_dispatch(multi_fn, max_bytes: int,
+                          cap_bytes: int = GROUPED_BATCH_BYTES):
+    """ONE grouping policy for the encode / coalescing-batcher /
+    rebuild pipelines: returns (multi_fn or None, group, max_bytes).
+
+    Group width comes from rs_jax.host_dispatch_group() — >1 only on a
+    single-device accelerator (multi-chip paths mesh-shard each batch
+    via parallel/mesh instead; CPU backends never take the word-form
+    device path). When grouping is on, the per-item byte bound is
+    clamped to ``cap_bytes`` (see GROUPED_BATCH_BYTES)."""
+    from ..ops import rs_jax
+    group = rs_jax.host_dispatch_group()
+    if group <= 1:
+        return None, 1, max_bytes
+    return multi_fn, group, min(max_bytes, cap_bytes)
 
 
 class PipelineError(RuntimeError):
@@ -43,7 +67,10 @@ class PipelineError(RuntimeError):
 def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
                  encode_fn: Callable[[np.ndarray], Any],
                  write_fn: Callable[[Any, np.ndarray, np.ndarray], None],
-                 depth: int = DEPTH) -> int:
+                 depth: int = DEPTH,
+                 encode_multi_fn: Optional[
+                     Callable[[list], list]] = None,
+                 group: int = 1) -> int:
     """Drive (meta, host_batch) items through encode_fn with full
     read/compute/write overlap.
 
@@ -51,7 +78,20 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
     value (or a host array — the loop still overlaps read and write);
     ``write_fn(meta, batch, result_np)`` runs on the writer thread in
     FIFO order, so per-file appends stay ordered. Returns the number of
-    batches processed. Exceptions from any stage propagate."""
+    batches processed. Exceptions from any stage propagate.
+
+    When ``encode_multi_fn`` is given with ``group > 1``, the compute
+    stage drains up to ``group`` already-read batches per step and
+    dispatches them together (one device call on the word-form path —
+    rs_jax.apply_matrix_host_multi), amortizing the per-dispatch floor
+    that dominates single-slab device calls (PERF.md round-5 race).
+    Grouping is greedy, never waiting on the reader: when the device
+    outruns the disk the group degrades to 1 and latency is unchanged;
+    when the disk outruns the device the read queue fills and full
+    groups form. Queue depth grows to ``group`` so groups CAN form —
+    host memory is bounded by the caller's batch size times group."""
+    if encode_multi_fn is not None and group > 1:
+        depth = max(depth, group)
     read_q: queue.Queue = queue.Queue(maxsize=depth)
     write_q: queue.Queue = queue.Queue(maxsize=depth)
     errors: list[BaseException] = []
@@ -92,16 +132,34 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
     wt.start()
     n = 0
     try:
-        while True:
+        ended = False
+        while not ended:
             item = read_q.get()
             if item is _END:
                 break
             if stop.is_set():
                 continue  # drain reader after writer failure
-            meta, batch = item
-            result = encode_fn(batch)
-            write_q.put((meta, batch, result))
-            n += 1
+            if encode_multi_fn is None or group <= 1:
+                meta, batch = item
+                result = encode_fn(batch)
+                write_q.put((meta, batch, result))
+                n += 1
+                continue
+            # greedy group: whatever is already queued, up to `group`
+            items = [item]
+            while len(items) < group:
+                try:
+                    nxt = read_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _END:
+                    ended = True
+                    break
+                items.append(nxt)
+            results = encode_multi_fn([b for _, b in items])
+            for (meta, batch), result in zip(items, results):
+                write_q.put((meta, batch, result))
+            n += len(items)
     finally:
         write_q.put(_END)
         wt.join()

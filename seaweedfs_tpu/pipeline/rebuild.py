@@ -16,6 +16,7 @@ import numpy as np
 
 from ..ops.rs_ref import TooFewShardsError
 from ..storage import ec_files
+from . import pipe
 from .scheme import DEFAULT_SCHEME, EcScheme
 
 #: Chunk of shard-file bytes processed per device call.
@@ -52,19 +53,42 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     # Only the first k survivors feed the decode matrix — don't read the
     # rest from disk at all.
     present = present[:scheme.data_shards]
+    k = scheme.data_shards
     reconstruct = _pick_reconstruct_fn(scheme, present, missing)
+    # Grouped dispatch on a single accelerator (one shared policy —
+    # pipe.pick_grouped_dispatch); a chunk's input bytes are k x the
+    # per-shard take, so the clamp converts back through k. Multi-chip
+    # keeps per-chunk mesh sharding via _pick_reconstruct_fn.
+    enc = scheme.encoder
+    reconstruct_multi, group, grouped_total = pipe.pick_grouped_dispatch(
+        lambda chunks: enc.reconstruct_batch_host_multi(
+            chunks, present, missing),
+        k * chunk_bytes)
+    if group > 1:
+        chunk_bytes = max(1, grouped_total // k)
     ins = [open(ec_files.shard_path(base, i), "rb") for i in present]
     outs = [open(ec_files.shard_path(base, i), "wb") for i in missing]
-    try:
+
+    def chunks():
         pos = 0
         while pos < size:
             take = min(chunk_bytes, size - pos)
-            chunk = np.stack([
-                np.frombuffer(f.read(take), dtype=np.uint8) for f in ins])
-            rebuilt = np.asarray(reconstruct(chunk[None]))[0]
-            for row, f in zip(rebuilt, outs):
-                row.tofile(f)
+            yield None, np.stack([
+                np.frombuffer(f.read(take), dtype=np.uint8) for f in ins])[
+                    None]
             pos += take
+
+    def write(_meta, _chunk, rebuilt):
+        for row, f in zip(rebuilt[0], outs):
+            row.tofile(f)
+
+    try:
+        # pipelined like encode: shard reads, device reconstruct and
+        # shard writes overlap, and on a single accelerator several
+        # chunks share one dispatch (the same grouped word-form path
+        # the encoder uses — see pipe.run_pipeline).
+        pipe.run_pipeline(chunks(), reconstruct, write,
+                          encode_multi_fn=reconstruct_multi, group=group)
     finally:
         for f in ins + outs:
             f.close()
