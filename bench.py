@@ -605,13 +605,18 @@ def child_core() -> None:
                       ("swarW64", _swarW64, 4, "w4"),
                       ("transpW", _transpW, 8, "w5"),
                       ("swarW64", _swarW64, 8, "w4"),
-                      # n16 reuses each uploaded slab twice per call
-                      # (re-uploading 8 more through the ~24 MiB/s
-                      # tunnel would cost minutes of window for a ~7%
-                      # projected gain); the in-jit fold still forces
-                      # every encode to execute. DEAD LAST: a 2.5 GiB
-                      # arg-set compile failure may only cost tail time.
-                      ("transpW", _transpW, 16, "w5")]
+                      # n16/n32 reuse each uploaded slab 2x/4x per call
+                      # (re-uploading more through the ~24 MiB/s tunnel
+                      # would cost minutes of window); the in-jit fold
+                      # still forces every encode to execute. DEAD
+                      # LAST: a 2.5-5 GiB arg-set compile failure may
+                      # only cost tail time. n16 won the 2026-07-31
+                      # window at 119.13 GiB/s; swarW_n16 and
+                      # transpW_n32 probe whether the amortization
+                      # curve has more room.
+                      ("transpW", _transpW, 16, "w5"),
+                      ("swarW64", _swarW64, 16, "w4"),
+                      ("transpW", _transpW, 32, "w5")]
 
     compute_gibps = 0.0
     best_name = None

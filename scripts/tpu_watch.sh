@@ -9,10 +9,12 @@ cd /root/repo || exit 1
 mkdir -p artifacts
 LOG=artifacts/tpu_watch.log
 while true; do
-  # TPU_SUCCESS (2.02 GiB/s, 2026-07-30) is banked; keep hunting for a
-  # faster headline until the improved-bench marker lands.
-  if [ -f artifacts/TPU_SUCCESS2 ]; then
-    echo "$(date +%s) improved-success-marker-present; watcher exiting" >> "$LOG"
+  # TPU_SUCCESS2 (119.13 GiB/s, 2026-07-31) is banked; the remaining
+  # goal is validating the GROUPED PRODUCTION DISPATCH on hardware
+  # (bench extras dispatch_multi_gibps, added after that window) —
+  # keep hunting until a run carries it (TPU_SUCCESS3 marker).
+  if [ -f artifacts/TPU_SUCCESS3 ]; then
+    echo "$(date +%s) grouped-dispatch-validated marker present; watcher exiting" >> "$LOG"
     exit 0
   fi
   if [ -f artifacts/tpu.lock ]; then
@@ -71,9 +73,20 @@ except Exception:
 v = new.get("value", 0)
 if v >= old.get("value", 0):
     json.dump(new, open("artifacts/TPU_SUCCESS", "w"))
-if v >= 4.0:
+try:
+    old2 = json.load(open("artifacts/TPU_SUCCESS2"))
+except Exception:
+    old2 = {}
+# same better-only guard as TPU_SUCCESS: a slower-but->=4.0 rerun must
+# not clobber the banked best
+if v >= 4.0 and v >= old2.get("value", 0):
     json.dump(new, open("artifacts/TPU_SUCCESS2", "w"))
 ex = new.get("extras", {})
+# grouped production dispatch validated on hardware: the multi
+# executable ran and reached at least half the raced throughput
+if (ex.get("dispatch_multi_gibps") or 0) > 0 and \
+        (ex.get("dispatch_multi_vs_race_frac") or 0) >= 0.5:
+    json.dump(new, open("artifacts/TPU_SUCCESS3", "w"))
 best = {}
 for kern in ("transpW", "swarW64"):
     vals = [val for key, val in ex.items()
@@ -88,11 +101,11 @@ if "swarW64" in best and "transpW" in best:
     json.dump({"kernel": winner, "evidence": best, "bench_ts": ts},
               open("artifacts/KERNEL_CHOICE.json", "w"))
 PYEOF
-      if [ -f artifacts/TPU_SUCCESS2 ]; then
-        echo "$TS improved TPU result recorded; watcher exiting" >> "$LOG"
+      if [ -f artifacts/TPU_SUCCESS3 ]; then
+        echo "$TS grouped dispatch validated on hardware; watcher exiting" >> "$LOG"
         exit 0
       fi
-      echo "$TS non-degraded TPU result recorded (not yet improved)" >> "$LOG"
+      echo "$TS non-degraded TPU result recorded (grouped dispatch not yet validated)" >> "$LOG"
     fi
   fi
   sleep 180

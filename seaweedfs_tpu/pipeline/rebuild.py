@@ -65,7 +65,13 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
             chunks, present, missing),
         k * chunk_bytes)
     if group > 1:
-        chunk_bytes = max(1, grouped_total // k)
+        # the per-shard take IS the word-form S here, so it must stay a
+        # multiple of both kernels' segment sizes or _host_word_form
+        # rejects every chunk and the fast path never engages (k=10
+        # makes a naive //k non-aligned)
+        from ..ops import rs_pallas
+        align = max(rs_pallas.SEG_BYTES, rs_pallas.SWAR_SEG_BYTES)
+        chunk_bytes = max(align, (grouped_total // k) // align * align)
     ins = [open(ec_files.shard_path(base, i), "rb") for i in present]
     outs = [open(ec_files.shard_path(base, i), "wb") for i in missing]
 
