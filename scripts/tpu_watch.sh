@@ -55,52 +55,18 @@ while true; do
     rm -f artifacts/tpu.lock
     echo "$TS bench rc=$BRC: $(cat artifacts/BENCH_attempt_$TS.json)" >> "$LOG"
     if grep -q '"degraded": false' "artifacts/BENCH_attempt_$TS.json"; then
-      # Bank into TPU_SUCCESS only when the new value beats the banked
-      # one (a slow-tunnel rerun must not clobber a better result); stop
-      # hunting once the improved (multi-arg / SWAR) headline clears 4.0.
-      # Also: measured kernel promotion — when the equality-gated race
-      # crowns SWAR over transpose by >10% at the same nargs, write
-      # KERNEL_CHOICE.json so production dispatch (ops/rs_jax.py)
-      # adopts the winner without a code change.
-      python - "$TS" <<'PYEOF'
-import json, sys
-ts = sys.argv[1]
-new = json.load(open(f"artifacts/BENCH_attempt_{ts}.json"))
-try:
-    old = json.load(open("artifacts/TPU_SUCCESS"))
-except Exception:
-    old = {}
-v = new.get("value", 0)
-if v >= old.get("value", 0):
-    json.dump(new, open("artifacts/TPU_SUCCESS", "w"))
-try:
-    old2 = json.load(open("artifacts/TPU_SUCCESS2"))
-except Exception:
-    old2 = {}
-# same better-only guard as TPU_SUCCESS: a slower-but->=4.0 rerun must
-# not clobber the banked best
-if v >= 4.0 and v >= old2.get("value", 0):
-    json.dump(new, open("artifacts/TPU_SUCCESS2", "w"))
-ex = new.get("extras", {})
-# grouped production dispatch validated on hardware: the multi
-# executable ran and reached at least half the raced throughput
-if (ex.get("dispatch_multi_gibps") or 0) > 0 and \
-        (ex.get("dispatch_multi_vs_race_frac") or 0) >= 0.5:
-    json.dump(new, open("artifacts/TPU_SUCCESS3", "w"))
-best = {}
-for kern in ("transpW", "swarW64"):
-    vals = [val for key, val in ex.items()
-            if key.startswith(f"headline_{kern}_")
-            and key.endswith("_gibps")
-            and isinstance(val, (int, float))]
-    if vals:
-        best[kern] = max(vals)
-if "swarW64" in best and "transpW" in best:
-    winner = ("swar" if best["swarW64"] > 1.10 * best["transpW"]
-              else "transpose")
-    json.dump({"kernel": winner, "evidence": best, "bench_ts": ts},
-              open("artifacts/KERNEL_CHOICE.json", "w"))
-PYEOF
+      # Banking rules (better-only guards, improved-race + grouped-
+      # dispatch markers, measured kernel promotion) live in
+      # scripts/bank_result.py so they are unit-tested — a banking bug
+      # must never waste a tunnel window. A banking FAILURE is loud:
+      # the attempt json is preserved either way, so the evidence
+      # survives and the failure marker says where to look.
+      python scripts/bank_result.py "$TS" >> "$LOG" 2>&1
+      BANK_RC=$?
+      if [ "$BANK_RC" != "0" ]; then
+        echo "$TS BANK_FAILED rc=$BANK_RC (attempt json kept: BENCH_attempt_$TS.json)" >> "$LOG"
+        echo "$TS rc=$BANK_RC" > artifacts/BANK_FAILED
+      fi
       if [ -f artifacts/TPU_SUCCESS3 ]; then
         echo "$TS grouped dispatch validated on hardware; watcher exiting" >> "$LOG"
         exit 0
