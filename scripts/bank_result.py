@@ -43,6 +43,17 @@ def _load(path: Path) -> dict:
         return {}
 
 
+def _write(path: Path, obj: dict) -> None:
+    """Atomic marker write (temp + rename): a kill mid-write must never
+    corrupt a banked best — _load would read the torn file as 'no
+    prior result' and let a worse later run clobber the evidence."""
+    import os
+
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps(obj))
+    os.replace(tmp, path)
+
+
 def _best_kernel_gibps(extras: dict, kern: str):
     vals = [v for k, v in extras.items()
             if k.startswith(f"headline_{kern}_") and k.endswith("_gibps")
@@ -57,16 +68,16 @@ def bank(attempt: dict, artifacts: Path, ts: str = "") -> list[str]:
     extras = attempt.get("extras", {}) or {}
 
     if v >= (_load(artifacts / "TPU_SUCCESS").get("value", 0) or 0):
-        (artifacts / "TPU_SUCCESS").write_text(json.dumps(attempt))
+        _write(artifacts / "TPU_SUCCESS", attempt)
         written.append("TPU_SUCCESS")
     if v >= IMPROVED_FLOOR_GIBPS and \
             v >= (_load(artifacts / "TPU_SUCCESS2").get("value", 0) or 0):
-        (artifacts / "TPU_SUCCESS2").write_text(json.dumps(attempt))
+        _write(artifacts / "TPU_SUCCESS2", attempt)
         written.append("TPU_SUCCESS2")
     if (extras.get("dispatch_multi_gibps") or 0) > 0 and \
             (extras.get("dispatch_multi_vs_race_frac") or 0) \
             >= DISPATCH_MULTI_MIN_FRAC:
-        (artifacts / "TPU_SUCCESS3").write_text(json.dumps(attempt))
+        _write(artifacts / "TPU_SUCCESS3", attempt)
         written.append("TPU_SUCCESS3")
 
     best = {k: g for k in ("transpW", "swarW64")
@@ -74,8 +85,8 @@ def bank(attempt: dict, artifacts: Path, ts: str = "") -> list[str]:
     if "swarW64" in best and "transpW" in best:
         winner = ("swar" if best["swarW64"]
                   > PROMOTION_MARGIN * best["transpW"] else "transpose")
-        (artifacts / "KERNEL_CHOICE.json").write_text(json.dumps(
-            {"kernel": winner, "evidence": best, "bench_ts": ts}))
+        _write(artifacts / "KERNEL_CHOICE.json",
+               {"kernel": winner, "evidence": best, "bench_ts": ts})
         written.append("KERNEL_CHOICE.json")
     return written
 

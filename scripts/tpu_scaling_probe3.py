@@ -1,15 +1,23 @@
-"""Probe 3: SWAR (transpose-free) kernel vs the bit-transpose kernel.
+"""Probe 3: SWAR vs transpose kernels + the compile-envelope edges.
 
 Probe2 found the transpose kernel's marginal cost ~0.18 ms/MiB
 (~5.5 GiB/s) with ~14 ms fixed per call — ~150x above the HBM floor,
 suggesting Mosaic lowers the reshape/stack/slice-heavy 32x32 bit
-transposes into VMEM copies. This probe times:
+transposes into VMEM copies. The first probe3 run (2026-07-31) got
+3 probes into a 900 s window because every probe re-uploaded its
+slabs through the ~24 MiB/s tunnel; this version uploads ONE slab
+pool and reuses it everywhere (device-side slicing serves the
+smaller-S probes), then maps what no run has yet measured:
 
-  A. SWAR kernel at S in {4, 16} MiB, rows_per_block in {256, 512, 1024}
-  B. SWAR multi-arg dispatch (2 and 4 args x 160 MiB)
-  C. on-device correctness spot-check of SWAR vs the transpose kernel
+  A. SWAR kernel at S in {4, 16} MiB, rpb {64, 256}, CSE A/B
+  B. SWAR multi-arg dispatch (2/4/8 args x 160 MiB)
+  C. transpose-kernel rb edge walk (20/24/28, toward probe2's
+     known-bad 32)
+  D. per-BUFFER remote-compile ceiling walk via AOT compile with
+     abstract shapes — ZERO upload: probe2 bracketed the ceiling at
+     [160 MiB ok, ~310 MiB fails]; this walks 200/240/280/320.
 
-Results: artifacts/TPU_SCALING_PROBE3.json.
+Results: artifacts/TPU_SCALING_PROBE3.json (merged per-probe rows).
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 def main() -> int:
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from seaweedfs_tpu.ops import rs_pallas
@@ -70,11 +79,23 @@ def main() -> int:
         print(f"equality check FAILED {res['device_equal_error']}", flush=True)
     persist()
 
+    # -- slab pool: uploaded ONCE, reused by every timed probe ------------
+    S0 = 16 * MIB
+    pool = [jax.device_put(rng.integers(0, 256, size=(1, k, S0),
+                                        dtype=np.uint8))
+            for _ in range(2)]
+    jax.block_until_ready(pool)
+    print(f"slab pool resident: 2 x {k * S0 // MIB} MiB", flush=True)
+
+    def slabs_at(s: int):
+        # device-side slice: no new host->device traffic
+        return [p if s == S0 else p[..., :s] for p in pool]
+
     def timed(tag: str, s: int, rpb: int, nargs: int = 1,
               cse: bool = True, kernel=None) -> None:
-        """One timed probe; ``kernel`` overrides the default SWAR
-        lambda (the transpose rb walk reuses this exact harness so
-        every probe row carries the same fields)."""
+        """One timed probe over the shared pool; ``kernel`` overrides
+        the default SWAR lambda (the transpose rb walk reuses this
+        exact harness so every probe row carries the same fields)."""
         probe = {"tag": tag, "slab_mib": s / MIB, "rows_per_block": rpb,
                  "nargs": nargs, "cse": cse,
                  "input_mib": nargs * k * s // MIB}
@@ -83,9 +104,11 @@ def main() -> int:
                 lambda c, x: rs_pallas.apply_gf_matrix_swar(
                     c, x, rows_per_block=rpb, cse=cse))
             fn = _make_folded_fn(gf, coefs, nargs)
-            groups = [tuple(jax.device_put(rng.integers(
-                        0, 256, size=(1, k, s), dtype=np.uint8))
-                    for _ in range(nargs)) for _ in range(2)]
+            src = slabs_at(s)
+            # two groups with rotated slab assignment: distinct inputs
+            # per call without any new uploads
+            groups = [tuple(src[(j + r) % len(src)] for j in range(nargs))
+                      for r in range(2)]
             passes = 3
             t, warm_s = _time_folded(fn, groups, passes)
             probe["warm_s"] = round(warm_s, 1)  # compile + first touch
@@ -98,7 +121,6 @@ def main() -> int:
                   f"{probe['input_mib']:5d} MiB/call "
                   f"{probe['ms_per_call']:7.1f} ms/call -> "
                   f"{probe['gibps']:.2f} GiB/s", flush=True)
-            del groups
         except Exception as e:  # noqa: BLE001
             probe["error"] = f"{type(e).__name__}: {e}"[:200]
             print(f"{tag}: FAILED {probe['error']}", flush=True)
@@ -109,12 +131,39 @@ def main() -> int:
         """Transpose-kernel rb edge walk (VERDICT r4 item 6: probe2's
         rb=32 HTTP 500 left the VMEM/block envelope unmapped; rb=16 is
         the known-good default, so map 20/24/28 before the known-bad).
-        S is the largest multiple of the rb granule under ~16 MiB;
-        rides the SAME timed() harness as the SWAR probes."""
+        S is the largest multiple of the rb granule fitting the pool
+        slab; rides the SAME timed() harness as the SWAR probes."""
         gran = 4 * 32 * rb * 128
-        s = gran * max(1, (16 * MIB) // gran)
+        s = gran * max(1, S0 // gran)
         timed(tag, s, rpb=rb,
               kernel=lambda c, x: rs_pallas.apply_gf_matrix(c, x, rb=rb))
+
+    def compile_only(tag: str, s_mib: int) -> None:
+        """D: per-BUFFER remote-compile ceiling via AOT compile of the
+        word-form transpose kernel at an ABSTRACT (1, k, s) shape —
+        maps the [160 MiB ok, ~310 MiB fail] bracket with zero upload
+        cost. A failure here is one exception, not a lost window."""
+        probe = {"tag": tag, "slab_mib": s_mib, "compile_only": True,
+                 "buffer_mib": k * s_mib}
+        try:
+            s = s_mib * MIB
+            w = s // 4
+            lanes, gw = rs_pallas.LANES, rs_pallas.GROUP_WORDS
+            shape = jax.ShapeDtypeStruct(
+                (1, k, gw, w // (gw * lanes), lanes), jnp.uint32)
+            t0 = time.perf_counter()
+            jax.jit(lambda x: rs_pallas.apply_gf_matrix_words(coefs, x)) \
+                .lower(shape).compile()
+            probe["compile_s"] = round(time.perf_counter() - t0, 1)
+            probe["ok"] = True
+            print(f"{tag}: {k}x{s_mib} MiB buffer compiles "
+                  f"({probe['compile_s']}s)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            probe["ok"] = False
+            probe["error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"{tag}: FAILED {probe['error']}", flush=True)
+        res["probes"].append(probe)
+        persist()
 
     # Small blocks first: compile-safe, and the S-intercept separates
     # per-call overhead from per-byte kernel cost for SWAR.
@@ -125,12 +174,17 @@ def main() -> int:
     timed("B.2arg", 16 * MIB, 64, nargs=2)
     timed("B.4arg", 16 * MIB, 64, nargs=4)
     timed("B.8arg", 16 * MIB, 64, nargs=8)
-    # transpose rb edge: walk toward probe2's known-bad rb=32 LAST (a
-    # compile failure here is caught per-probe; a helper hang costs
-    # only this bounded child)
+    # transpose rb edge: walk toward probe2's known-bad rb=32 LAST among
+    # the timed probes (a compile failure is caught per-probe; a helper
+    # hang costs only this bounded child)
     timed_t("C.rb20", 20)
     timed_t("C.rb24", 24)
     timed_t("C.rb28", 28)
+    # buffer-ceiling walk, zero-upload — dead last (known-bad at 320)
+    compile_only("D.buf200", 20)
+    compile_only("D.buf240", 24)
+    compile_only("D.buf280", 28)
+    compile_only("D.buf320", 32)
     return 0
 
 

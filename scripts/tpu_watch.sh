@@ -66,6 +66,8 @@ while true; do
       if [ "$BANK_RC" != "0" ]; then
         echo "$TS BANK_FAILED rc=$BANK_RC (attempt json kept: BENCH_attempt_$TS.json)" >> "$LOG"
         echo "$TS rc=$BANK_RC" > artifacts/BANK_FAILED
+      else
+        rm -f artifacts/BANK_FAILED  # a later success clears the alarm
       fi
       if [ -f artifacts/TPU_SUCCESS3 ]; then
         echo "$TS grouped dispatch validated on hardware; watcher exiting" >> "$LOG"

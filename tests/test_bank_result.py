@@ -94,6 +94,19 @@ def test_no_promotion_without_both_kernels(tmp_path):
     assert not (tmp_path / "KERNEL_CHOICE.json").exists()
 
 
+def test_writes_are_atomic_and_leave_no_temp(tmp_path):
+    bank_result.bank(_attempt(119.1, {
+        "headline_transpW_n16_gibps": 119.1,
+        "headline_swarW64_n8_gibps": 54.2,
+        "dispatch_multi_gibps": 100.0,
+        "dispatch_multi_vs_race_frac": 0.84}), tmp_path)
+    assert not list(tmp_path.glob("*.tmp")), "temp files left behind"
+    # every marker parses (no torn writes)
+    for name in ("TPU_SUCCESS", "TPU_SUCCESS2", "TPU_SUCCESS3",
+                 "KERNEL_CHOICE.json"):
+        json.loads((tmp_path / name).read_text())
+
+
 def test_main_reads_attempt_by_ts(tmp_path):
     (tmp_path / "BENCH_attempt_123.json").write_text(
         json.dumps(_attempt(50.0)))
