@@ -914,6 +914,20 @@ def child_core() -> None:
         # e2e's actual filesystem under its own keys, so storage speed
         # is never misread as codec slowness (PERF.md).
         res["disk_write_gibps"] = round(_disk_write_gibps(), 3)
+        # Host-DRAM honesty figure: the e2e file path touches every
+        # byte several times on the HOST (memmap read, stripe copy,
+        # codec read+write, shard write), so its ceiling is the
+        # machine's large-working-set memory bandwidth — NOT the codec.
+        # Measured with one cold 256 MiB copy; on this build container
+        # a single throttled vCPU moves ~0.17 GiB/s at that size (13 MiB
+        # cache-resident loops run ~15x faster, which is why small-probe
+        # figures like the GFNI baseline look faster than any e2e can
+        # be). Compare encode_e2e_file_gibps against THIS, not against
+        # the device or codec numbers.
+        res["host_dram_copy_gibps"] = round(_host_dram_copy_gibps(), 3)
+        log(f"host DRAM (cold 256 MiB copy): "
+            f"{res['host_dram_copy_gibps']:.2f} GiB/s "
+            f"(the e2e file path's host-side ceiling)")
         e2e_size = GIB if (on_acc and not interp) else 64 * MIB
         fast = _fast_tmpdir(need_bytes=int(2.6 * e2e_size) + 64 * MIB)
         res["e2e_file_fs"] = "tmpfs" if fast else "disk"
@@ -1025,6 +1039,22 @@ def _disk_write_gibps(n_bytes: int = 64 * MIB,
     return n_bytes / GIB / dt
 
 
+def _host_dram_copy_gibps(n_bytes: int = 256 * MIB) -> float:
+    """Large-working-set host memory bandwidth: one cold copy of a
+    fresh buffer (too big for cache, so both the read and the write
+    stream hit DRAM). This is the host-side ceiling for any e2e file
+    path — see the honesty note at the call site."""
+    import numpy as np
+
+    src = np.random.default_rng(3).integers(0, 256, n_bytes,
+                                            dtype=np.uint8)
+    t0 = time.perf_counter()
+    dst = src.copy()
+    dt = time.perf_counter() - t0
+    del dst
+    return n_bytes / GIB / dt
+
+
 def _fast_tmpdir(need_bytes: int) -> str | None:
     """/dev/shm when usable AND large enough — the container disk
     writes ~0.1 GiB/s, which would measure the disk, not the encode
@@ -1062,6 +1092,38 @@ def _bench_end_to_end(on_acc: bool, fast: str | None) -> float:
     size = GIB if on_acc else 64 * MIB
     if fast is None:
         size = min(size, 256 * MIB)  # don't grind the slow disk for 1 GiB
+    # Warm the one-time costs OUT of the timed window (the bench's own
+    # honesty rule #3 — warm-up never counts): the hybrid dispatch's
+    # first encode triggers the native codec's g++ build + table setup
+    # and the link-vs-codec calibration probes; before this warm-up
+    # they landed inside the e2e clock (several seconds of the r5
+    # window's 18.6 s). A small throwaway encode also warms the main
+    # batch-shape executable on whichever leg the hybrid picks.
+    # Residual honesty note: on a fast-link accelerator the big run's
+    # LATER grouped widths / tail shapes may still first-compile
+    # in-window — the warm volume can't enumerate them all.
+    try:
+        from seaweedfs_tpu.ops import rs_jax as rs_jax_mod
+        from seaweedfs_tpu.ops import rs_native as rs_native_mod
+        if rs_native_mod.available():
+            import numpy as _np
+            rs_native_mod.apply_gf_matrix(
+                _np.ones((4, 10), dtype=_np.uint8),
+                _np.zeros((10, 1 << 16), dtype=_np.uint8))
+        rs_jax_mod._device_worth_it()
+        from seaweedfs_tpu.pipeline import encode as encode_mod
+        from seaweedfs_tpu.storage import superblock as sb_mod
+        from seaweedfs_tpu.storage import volume as vol_mod
+        import numpy as _np
+        with tempfile.TemporaryDirectory(dir=fast) as wtd:
+            wbase = os.path.join(wtd, "0")
+            with open(vol_mod.dat_path(wbase), "wb") as f:
+                f.write(sb_mod.SuperBlock().to_bytes())
+                f.write(_np.zeros(32 * MIB - 8, dtype=_np.uint8)
+                        .tobytes())
+            encode_mod.write_ec_files(wbase)
+    except Exception as e:  # noqa: BLE001 — warm-up must never kill e2e
+        log(f"e2e warm-up skipped: {e}")
     with tempfile.TemporaryDirectory(dir=fast) as td:
         base = os.path.join(td, "1")
         rng = np.random.default_rng(7)

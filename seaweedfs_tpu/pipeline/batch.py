@@ -135,10 +135,13 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
                   max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES) -> int:
     """Coalesced encode over many volumes with the 3-stage pipeline.
 
-    ``sink(key, shard_id, offset, block_bytes)`` receives each span's
-    bytes addressed by shard-file offset (spans of one (key, shard) are
-    disjoint and cover the file). Data shards come straight from the
-    host batch, parity from the device. Returns total input bytes."""
+    ``sink(key, shard_id, offset, blocks)`` receives each span's bytes
+    addressed by shard-file offset (spans of one (key, shard) are
+    disjoint and cover the file). ``blocks`` may be a strided (n,
+    block) VIEW whose rows are contiguous — sinks either write row-wise
+    (zero-copy) or flatten (ravel/reshape copies on demand). Data
+    shards come straight from the host batch, parity from the device.
+    Returns total input bytes."""
     k = scheme.data_shards
     total = 0
 
@@ -150,13 +153,18 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
             yield spans, packed
 
     def write(spans, batch, parity):
+        # Views, not np.ascontiguousarray: each span row is already
+        # contiguous, and the gather-copy per (span, shard) cost ~0.5x
+        # the volume in extra DRAM traffic (the e2e host ceiling on a
+        # bandwidth-poor host — see PERF.md). Sinks that need flat
+        # bytes (ravel/reshape/tofile) still get them; the file sink
+        # writes row-wise with no copy at all.
         for sp in spans:
             for s in range(k):
-                sink(sp.key, s, sp.offset, np.ascontiguousarray(
-                    batch[sp.r0:sp.r0 + sp.n, s]))
+                sink(sp.key, s, sp.offset, batch[sp.r0:sp.r0 + sp.n, s])
             for j in range(parity.shape[1]):
-                sink(sp.key, k + j, sp.offset, np.ascontiguousarray(
-                    parity[sp.r0:sp.r0 + sp.n, j]))
+                sink(sp.key, k + j, sp.offset,
+                     parity[sp.r0:sp.r0 + sp.n, j])
 
     # Grouped dispatch on a single accelerator (one shared policy —
     # pipe.pick_grouped_dispatch): runs of same-shaped coalesced
@@ -202,10 +210,14 @@ def encode_many(payloads: Sequence[np.ndarray],
 
     def sink(key, shard_id, offset, blocks):
         if pieces is not None:
+            # keep_output must own the bytes: copy the (possibly
+            # strided) span view into a flat array
             pieces.setdefault((key, shard_id), []).append(
-                (offset, blocks.reshape(-1)))
-        else:
-            blocks.ravel()  # already materialized by the pipeline D2H
+                (offset, np.ascontiguousarray(blocks).reshape(-1)))
+        # else: true no-op. Parity was already materialized by the
+        # pipeline's D2H (np.asarray in pipe.run_pipeline) and data
+        # spans view the host batch — flattening here would re-add the
+        # gather copy the view-passing write path just removed.
 
     sources = ((i, np.asarray(p, dtype=np.uint8).ravel())
                for i, p in enumerate(payloads))
@@ -248,7 +260,13 @@ def encode_volumes(bases: Sequence[str | Path],
             f = open(ec_files.shard_path(base, shard_id), "wb")
             outs[(base, shard_id)] = f
         f.seek(offset)
-        blocks.tofile(f)
+        if blocks.ndim > 1:
+            # (n, block) span view: rows are contiguous even when the
+            # span itself is strided — write them without a gather copy
+            for row in blocks:
+                f.write(row.data)
+        else:
+            f.write(np.ascontiguousarray(blocks).data)
 
     try:
         return encode_packed(sources(), sink, scheme, max_batch_bytes)
