@@ -1097,29 +1097,30 @@ def _bench_end_to_end(on_acc: bool, fast: str | None) -> float:
     # first encode triggers the native codec's g++ build + table setup
     # and the link-vs-codec calibration probes; before this warm-up
     # they landed inside the e2e clock (several seconds of the r5
-    # window's 18.6 s). A small throwaway encode also warms the main
-    # batch-shape executable on whichever leg the hybrid picks.
-    # Residual honesty note: on a fast-link accelerator the big run's
-    # LATER grouped widths / tail shapes may still first-compile
-    # in-window — the warm volume can't enumerate them all.
+    # window's 18.6 s). The throwaway encode is sized to reproduce the
+    # main run's steady-state batch shape (grouped cap // row bytes
+    # rows, plus one tail row), so the device leg's width-1 executable
+    # compiles pre-clock too. Residual honesty note: on a fast-link
+    # accelerator the grouped multi-width executables may still
+    # first-compile in-window — the warm volume can't enumerate them.
     try:
         from seaweedfs_tpu.ops import rs_jax as rs_jax_mod
         from seaweedfs_tpu.ops import rs_native as rs_native_mod
+        from seaweedfs_tpu.pipeline import pipe as pipe_mod
+        from seaweedfs_tpu.pipeline.scheme import DEFAULT_SCHEME
         if rs_native_mod.available():
-            import numpy as _np
             rs_native_mod.apply_gf_matrix(
-                _np.ones((4, 10), dtype=_np.uint8),
-                _np.zeros((10, 1 << 16), dtype=_np.uint8))
+                np.ones((4, 10), dtype=np.uint8),
+                np.zeros((10, 1 << 16), dtype=np.uint8))
         rs_jax_mod._device_worth_it()
-        from seaweedfs_tpu.pipeline import encode as encode_mod
-        from seaweedfs_tpu.storage import superblock as sb_mod
-        from seaweedfs_tpu.storage import volume as vol_mod
-        import numpy as _np
+        row = DEFAULT_SCHEME.data_shards * DEFAULT_SCHEME.small_block_size
+        rpb = max(1, pipe_mod.GROUPED_BATCH_BYTES // row)
+        warm_bytes = min((rpb + 1) * row + 8, size)
         with tempfile.TemporaryDirectory(dir=fast) as wtd:
             wbase = os.path.join(wtd, "0")
-            with open(vol_mod.dat_path(wbase), "wb") as f:
-                f.write(sb_mod.SuperBlock().to_bytes())
-                f.write(_np.zeros(32 * MIB - 8, dtype=_np.uint8)
+            with open(volume_mod.dat_path(wbase), "wb") as f:
+                f.write(superblock_mod.SuperBlock().to_bytes())
+                f.write(np.zeros(warm_bytes - 8, dtype=np.uint8)
                         .tobytes())
             encode_mod.write_ec_files(wbase)
     except Exception as e:  # noqa: BLE001 — warm-up must never kill e2e

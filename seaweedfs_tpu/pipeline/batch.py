@@ -260,9 +260,11 @@ def encode_volumes(bases: Sequence[str | Path],
             f = open(ec_files.shard_path(base, shard_id), "wb")
             outs[(base, shard_id)] = f
         f.seek(offset)
-        if blocks.ndim > 1:
+        if blocks.ndim > 1 and \
+                blocks.shape[-1] >= pipe.ROW_WRITE_MIN_BLOCK:
             # (n, block) span view: rows are contiguous even when the
             # span itself is strided — write them without a gather copy
+            # (tiny blocks take the copy path; see pipe.py)
             for row in blocks:
                 f.write(row.data)
         else:

@@ -82,14 +82,22 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         # per shard (~0.5x the volume in extra memcpy, serialized under
         # the GIL against the reader's copies and the codec) is pure
         # waste — profiling showed it dominating the e2e file encode.
+        # Tiny blocks keep the copy path (pipe.ROW_WRITE_MIN_BLOCK).
+        row_ok = batch.shape[-1] >= pipe.ROW_WRITE_MIN_BLOCK
         for s in range(k):
             col = batch[:, s, :]
-            for r in range(col.shape[0]):
-                outs[s].write(col[r].data)
+            if row_ok:
+                for r in range(col.shape[0]):
+                    outs[s].write(col[r].data)
+            else:
+                np.ascontiguousarray(col).tofile(outs[s])
         for j in range(parity.shape[1]):
             col = parity[:, j, :]
-            for r in range(col.shape[0]):
-                outs[k + j].write(col[r].data)
+            if row_ok:
+                for r in range(col.shape[0]):
+                    outs[k + j].write(col[r].data)
+            else:
+                np.ascontiguousarray(col).tofile(outs[k + j])
 
     try:
         pipe.run_pipeline(batches(), scheme.encoder.encode_parity_host,

@@ -33,6 +33,12 @@ import numpy as np
 #: Stage-queue depth: 2 = classic double buffering.
 DEPTH = 2
 
+#: Row-view shard writes need rows at least this long: below it the
+#: per-row Python write() overhead beats the strided gather-copy it
+#: avoids (a 256-byte-block scheme would make ~1.4M tiny writes per
+#: 256 MiB batch), so smaller blocks take the copy+tofile path.
+ROW_WRITE_MIN_BLOCK = 64 * 1024
+
 #: Bound on one batch's INPUT bytes while grouped dispatch is active:
 #: the pipeline queues then hold up to `group` batches each, so the
 #: per-batch size shrinks to keep host memory and the ~160 MiB
