@@ -520,7 +520,10 @@ class _MasterServicer:
                             read_only=v.read_only,
                             replica_placement=ReplicaPlacement.parse(
                                 v.replica_placement).to_byte(),
-                            version=v.version)
+                            version=v.version,
+                            ttl=int.from_bytes(
+                                Ttl.parse(v.ttl or "").to_bytes(), "big"),
+                            modified_at_second=v.modified_at_second)
                     for (col, vid), bits in n.ec_shards.items():
                         dni.ec_shard_infos.add(
                             id=vid, collection=col, ec_index_bits=bits.bits)

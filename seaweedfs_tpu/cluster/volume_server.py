@@ -545,6 +545,33 @@ class _VolumeServicer:
             resp.ec_shard_ids.extend(sorted(m.shard_ids))
         return resp
 
+    def ReadNeedleBlob(self, request, context):
+        """Raw record bytes for one live needle (the replica-sync read
+        behind volume.check.disk; reference volume_grpc_read_write.go
+        ReadNeedleBlob)."""
+        store = self.vs.store
+        if not store.has_volume(request.volume_id, request.collection):
+            raise StoreError(f"volume {request.volume_id} not here")
+        v = store.get_volume(request.volume_id, request.collection)
+        rec, offset = v.read_record(request.needle_id)
+        return volume_server_pb2.ReadNeedleBlobResponse(
+            needle_blob=rec, offset=offset)
+
+    def WriteNeedleBlob(self, request, context):
+        """Append a raw record read from a sibling replica
+        (WriteNeedleBlob): bit-for-bit, so CRC/timestamps survive."""
+        from ..storage import needle as needle_mod
+        store = self.vs.store
+        if not store.has_volume(request.volume_id, request.collection):
+            raise StoreError(f"volume {request.volume_id} not here")
+        v = store.get_volume(request.volume_id, request.collection)
+        _c, key, _s = needle_mod.parse_header(request.needle_blob)
+        if key != request.needle_id:
+            raise StoreError(
+                f"blob header id {key} != request id {request.needle_id}")
+        offset = v.write_raw_record(bytes(request.needle_blob))
+        return volume_server_pb2.WriteNeedleBlobResponse(offset=offset)
+
     # ---- file streaming ----
 
     def CopyFile(self, request, context):

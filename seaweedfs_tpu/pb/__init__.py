@@ -61,6 +61,10 @@ VOLUME_METHODS = [
            volume_server_pb2.VolumeStatusResponse),
     Method("CopyFile", volume_server_pb2.CopyFileRequest,
            volume_server_pb2.CopyFileResponse, SERVER_STREAM),
+    Method("ReadNeedleBlob", volume_server_pb2.ReadNeedleBlobRequest,
+           volume_server_pb2.ReadNeedleBlobResponse),
+    Method("WriteNeedleBlob", volume_server_pb2.WriteNeedleBlobRequest,
+           volume_server_pb2.WriteNeedleBlobResponse),
     Method("VolumeCopy", volume_server_pb2.VolumeCopyRequest,
            volume_server_pb2.VolumeCopyResponse),
     Method("VolumeEcShardsGenerate",

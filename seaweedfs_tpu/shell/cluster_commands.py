@@ -695,6 +695,343 @@ def cmd_volume_grow(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"volume.grow: created volumes {doc['volumeIds']}")
 
 
+@cluster_command("volume.mark")
+def cmd_volume_mark(env: ClusterEnv, argv: list[str]) -> None:
+    """Mark a volume readonly/writable on its servers (the reference's
+    volume.mark; drives VolumeMarkReadonly/Writable on every replica,
+    or just one with -node)."""
+    p = _parser("volume.mark")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-node", default="",
+                   help="only this server (default: every replica)")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("-readonly", action="store_true")
+    g.add_argument("-writable", action="store_true")
+    args = p.parse_args(argv)
+    locs = [args.node] if args.node else \
+        env.volume_locations(args.volumeId)
+    if not locs:
+        raise ShellError(f"volume {args.volumeId} not found")
+    for url in locs:
+        stub = env.volume(url)
+        if args.readonly:
+            stub.VolumeMarkReadonly(
+                volume_server_pb2.VolumeMarkReadonlyRequest(
+                    volume_id=args.volumeId,
+                    collection=args.collection))
+        else:
+            stub.VolumeMarkWritable(
+                volume_server_pb2.VolumeMarkWritableRequest(
+                    volume_id=args.volumeId,
+                    collection=args.collection))
+    state = "readonly" if args.readonly else "writable"
+    env.println(f"volume.mark: volume {args.volumeId} {state} on "
+                f"{', '.join(locs)}")
+
+
+@cluster_command("volume.deleteEmpty")
+def cmd_volume_delete_empty(env: ClusterEnv, argv: list[str]) -> None:
+    """Delete volumes holding zero live files cluster-wide
+    (command_volume_delete_empty.go). Dry-runs unless -force; like the
+    reference, only volumes untouched for -quietFor seconds qualify —
+    the master's snapshot is heartbeat-stale, so a just-written volume
+    could otherwise still report zero files."""
+    import time as time_mod
+
+    p = _parser("volume.deleteEmpty")
+    p.add_argument("-collection", default="")
+    p.add_argument("-quietFor", type=int, default=86400,
+                   help="seconds since last modification (default 1d)")
+    p.add_argument("-force", action="store_true")
+    args = p.parse_args(argv)
+    resp = env.volume_list()
+    now = int(time_mod.time())
+    empties: list[tuple[int, str, str]] = []  # (vid, collection, node)
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for v in dn.volume_infos:
+                    if args.collection and \
+                            v.collection != args.collection:
+                        continue
+                    if v.file_count - v.delete_count > 0:
+                        continue
+                    # unknown mtime (0) is never "quiet"
+                    if not v.modified_at_second or \
+                            now - v.modified_at_second < args.quietFor:
+                        continue
+                    empties.append((v.id, v.collection, dn.id))
+    for vid, col, url in empties:
+        if args.force:
+            env.volume(url).VolumeDelete(
+                volume_server_pb2.VolumeDeleteRequest(
+                    volume_id=vid, collection=col))
+        env.println(f"volume.deleteEmpty: volume {vid} on {url}"
+                    + ("" if args.force else " (dry run; use -force)"))
+    env.println(f"volume.deleteEmpty: {len(empties)} empty volumes"
+                + (" deleted" if args.force else " found"))
+
+
+@cluster_command("volumeServer.evacuate")
+def cmd_volume_server_evacuate(env: ClusterEnv, argv: list[str]) -> None:
+    """Move every volume and EC shard off one server so it can be
+    decommissioned (command_volume_server_evacuate.go): volumes go to
+    the least-loaded server without a replica of them, EC shards
+    spread over the remaining nodes."""
+    p = _parser("volumeServer.evacuate")
+    p.add_argument("-node", required=True, help="server ip:port to drain")
+    args = p.parse_args(argv)
+    victim = args.node
+    resp = env.volume_list()
+    counts: dict[str, int] = {}   # node url -> volume count
+    caps: dict[str, int] = {}     # node url -> max volume count (0 = inf)
+    holds: dict[str, set[tuple[str, int]]] = {}
+    victim_vols: list = []
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                counts[dn.id] = dn.volume_count
+                caps[dn.id] = dn.max_volume_count
+                holds[dn.id] = {(v.collection, v.id)
+                                for v in dn.volume_infos}
+                if dn.id == victim:
+                    victim_vols = list(dn.volume_infos)
+    if victim not in counts:
+        raise ShellError(f"node {victim} not in topology")
+
+    def has_slot(u: str) -> bool:
+        return not caps[u] or counts[u] < caps[u]
+
+    moved = 0
+    for v in victim_vols:
+        # most free slots first, never onto a full node (the reference
+        # evacuate places by free capacity, not raw volume count)
+        targets = sorted(
+            (u for u in counts
+             if u != victim and has_slot(u)
+             and (v.collection, v.id) not in holds[u]),
+            key=lambda u: counts[u] - (caps[u] or 10 ** 9))
+        if not targets:
+            raise ShellError(
+                f"volumeServer.evacuate: no target with free space "
+                f"for volume {v.id}")
+        dst = targets[0]
+        _move_volume(env, v.id, v.collection, victim, dst)
+        counts[dst] += 1
+        holds[dst].add((v.collection, v.id))
+        env.println(f"volumeServer.evacuate: volume {v.id} -> {dst}")
+        moved += 1
+    # EC shards: spread over remaining nodes that lack that shard.
+    nodes = env.collect_ec_nodes()
+    vnode = next((n for n in nodes if n.url == victim), None)
+    others = [n for n in nodes if n.url != victim]
+    ec_moved = 0
+    if vnode is not None and vnode.shards:
+        if not others:
+            raise ShellError("volumeServer.evacuate: no other nodes "
+                             "for EC shards")
+        for vid, sids in sorted(vnode.shards.items()):
+            col = vnode.collections.get(vid, "")
+            for sid in sids:
+                tgts = sorted(
+                    (n for n in others
+                     if sid not in n.shards.get(vid, [])),
+                    key=lambda n: n.shard_count())
+                if not tgts:
+                    raise ShellError(
+                        f"volumeServer.evacuate: every node already "
+                        f"holds shard {vid}.{sid}")
+                t = tgts[0]
+                env.volume(t.url).VolumeEcShardsCopy(
+                    volume_server_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid, collection=col, shard_ids=[sid],
+                        copy_ecx_file=True, copy_ecj_file=True,
+                        copy_vif_file=True, source_data_node=victim))
+                env.volume(t.url).VolumeEcShardsMount(
+                    volume_server_pb2.VolumeEcShardsMountRequest(
+                        volume_id=vid, collection=col, shard_ids=[sid]))
+                env.volume(victim).VolumeEcShardsDelete(
+                    volume_server_pb2.VolumeEcShardsDeleteRequest(
+                        volume_id=vid, collection=col, shard_ids=[sid]))
+                t.shards.setdefault(vid, []).append(sid)
+                ec_moved += 1
+    env.println(f"volumeServer.evacuate: {victim} drained "
+                f"({moved} volumes, {ec_moved} ec shards)")
+
+
+@cluster_command("volume.check.disk")
+def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
+    """Verify replicas of each volume hold the same live needles and
+    sync divergence (command_volume_check_disk.go): stream every
+    replica's .idx, diff the live sets, and with -fix copy missing
+    needles raw (ReadNeedleBlob -> WriteNeedleBlob) so CRCs and
+    timestamps survive bit-for-bit. Needles tombstoned on one replica
+    are never resurrected onto it."""
+    from ..storage import idx as idx_mod
+    from ..storage.types import TOMBSTONE_FILE_SIZE
+
+    p = _parser("volume.check.disk")
+    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-collection", default="")
+    p.add_argument("-fix", action="store_true",
+                   help="sync missing needles (default: report only)")
+    args = p.parse_args(argv)
+    resp = env.volume_list()
+    # (collection, vid) -> [holder urls]
+    replicas: dict[tuple[str, int], list[str]] = {}
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for v in dn.volume_infos:
+                    if args.volumeId and v.id != args.volumeId:
+                        continue
+                    if args.collection and \
+                            v.collection != args.collection:
+                        continue
+                    replicas.setdefault(
+                        (v.collection, v.id), []).append(dn.id)
+
+    def live_map(url: str, vid: int,
+                 col: str) -> tuple[dict[int, int], set[int]]:
+        """(key -> size after tombstone replay, tombstoned keys)."""
+        blob = b"".join(
+            r.file_content for r in env.volume(url).CopyFile(
+                volume_server_pb2.CopyFileRequest(
+                    volume_id=vid, collection=col, ext=".idx")))
+        live: dict[int, int] = {}
+        dead: set[int] = set()
+        for e in idx_mod.walk_index_blob(blob):
+            if e.size == TOMBSTONE_FILE_SIZE:
+                live.pop(e.key, None)
+                dead.add(e.key)
+            else:
+                live[e.key] = e.size
+                dead.discard(e.key)
+        return live, dead
+
+    checked = synced = divergent = skews = 0
+    for (col, vid), urls in sorted(replicas.items(),
+                                   key=lambda kv: kv[0][1]):
+        if len(urls) < 2:
+            continue
+        checked += 1
+        maps: dict[str, dict[int, int]] = {}
+        deads: dict[str, set[int]] = {}
+        for u in urls:
+            maps[u], deads[u] = live_map(u, vid, col)
+        union: set[int] = set()
+        all_dead: set[int] = set()
+        for m in maps.values():
+            union.update(m)
+        for d in deads.values():
+            all_dead.update(d)
+        # A needle live on one replica but tombstoned on another is
+        # reported, never auto-resolved: resurrecting would undo a
+        # client's delete, deleting would need the client's cookie
+        # semantics — the operator decides (reference check.disk skips
+        # these the same way).
+        for k in sorted(union & all_dead):
+            holders_live = [u for u in urls if k in maps[u]]
+            if holders_live:
+                skews += 1
+                env.println(
+                    f"volume {vid} needle {k}: live on "
+                    f"{', '.join(holders_live)} but deleted elsewhere")
+        # Same key live everywhere but with different sizes = a missed
+        # overwrite; the idx alone cannot say which side is newer, so
+        # report it (never auto-pick a winner).
+        for k in sorted(union - all_dead):
+            sizes = {maps[u][k] for u in urls if k in maps[u]}
+            if len(sizes) > 1:
+                skews += 1
+                env.println(
+                    f"volume {vid} needle {k}: size differs across "
+                    f"replicas ({sorted(sizes)}) — missed overwrite")
+        # Keys deleted anywhere are excluded from syncing entirely:
+        # copying one onto a replica that never held it would spread a
+        # client-deleted needle (the skew report above covers them).
+        for u in urls:
+            missing = [k for k in union - all_dead
+                       if k not in maps[u]]
+            if not missing:
+                continue
+            divergent += 1
+            donors = [d for d in urls if d != u]
+            env.println(f"volume {vid} on {u}: {len(missing)} "
+                        f"needle(s) missing"
+                        + ("" if args.fix else " (dry run; use -fix)"))
+            if not args.fix:
+                continue
+            for k in sorted(missing):
+                donor = next(d for d in donors if k in maps[d])
+                blob = env.volume(donor).ReadNeedleBlob(
+                    volume_server_pb2.ReadNeedleBlobRequest(
+                        volume_id=vid, collection=col, needle_id=k))
+                env.volume(u).WriteNeedleBlob(
+                    volume_server_pb2.WriteNeedleBlobRequest(
+                        volume_id=vid, collection=col, needle_id=k,
+                        needle_blob=blob.needle_blob))
+                synced += 1
+    env.println(f"volume.check.disk: {checked} replicated volumes "
+                f"checked, {divergent} divergent replicas, "
+                f"{synced} needles synced, {skews} unresolved skews")
+
+
+@cluster_command("cluster.check")
+def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
+    """Read-only cluster health sweep (the reference's cluster.check):
+    replica deficits, EC volumes with shard-id gaps, and nodes at
+    volume capacity. Exits nonzero (ShellError) when problems exist."""
+    from ..storage.superblock import ReplicaPlacement
+
+    p = _parser("cluster.check")
+    p.parse_args(argv)
+    resp = env.volume_list()
+    vols: dict[int, tuple[str, int, list[str]]] = {}
+    full_nodes = 0
+    n_nodes = 0
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                n_nodes += 1
+                if dn.max_volume_count and \
+                        dn.volume_count >= dn.max_volume_count:
+                    full_nodes += 1
+                    env.println(f"node {dn.id} at capacity "
+                                f"({dn.volume_count}/"
+                                f"{dn.max_volume_count})")
+                for v in dn.volume_infos:
+                    col, rp, holders = vols.get(
+                        v.id, (v.collection, v.replica_placement, []))
+                    holders.append(dn.id)
+                    vols[v.id] = (col, rp, holders)
+    problems = full_nodes
+    for vid, (col, rp_byte, holders) in sorted(vols.items()):
+        want = ReplicaPlacement.from_byte(rp_byte).copy_count()
+        if len(holders) < want:
+            env.println(f"volume {vid} under-replicated: "
+                        f"{len(holders)}/{want} replicas")
+            problems += 1
+    # EC: shard ids present anywhere per volume; a gap below the max id
+    # is definitely a missing shard (totals need the .vif, so only
+    # provable gaps are reported — ec.rebuild is authoritative).
+    present: dict[int, set[int]] = {}
+    for n in env.collect_ec_nodes():
+        for vid, sids in n.shards.items():
+            present.setdefault(vid, set()).update(sids)
+    for vid, sids in sorted(present.items()):
+        gaps = sorted(set(range(max(sids) + 1)) - sids)
+        if gaps:
+            env.println(f"ec volume {vid} missing shards {gaps} "
+                        f"(run ec.rebuild)")
+            problems += 1
+    env.println(f"cluster.check: {n_nodes} nodes, {len(vols)} volumes, "
+                f"{len(present)} ec volumes, {problems} problems")
+    if problems:
+        raise ShellError(f"cluster.check: {problems} problems found")
+
+
 @cluster_command("cluster.status")
 def cmd_cluster_status(env: ClusterEnv, argv: list[str]) -> None:
     p = _parser("cluster.status")

@@ -141,6 +141,96 @@ def cmd_fs_mv(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"moved {_norm(args.src)} -> {_norm(args.dst)}")
 
 
+@cluster_command("fs.tree")
+def cmd_fs_tree(env: ClusterEnv, argv: list[str]) -> None:
+    """Recursively print the namespace as an indented tree
+    (command_fs_tree.go)."""
+    p = _parser("fs.tree")
+    p.add_argument("path", nargs="?", default="/")
+    args = p.parse_args(argv)
+    fc = _fc(env)
+    root = _norm(args.path)
+    env.println(root)
+    files = dirs = 0
+
+    def rec(d: str, indent: str) -> None:
+        nonlocal files, dirs
+        entries = list(fc.list(d))
+        for i, e in enumerate(entries):
+            last = i == len(entries) - 1
+            tee = "└── " if last else "├── "
+            env.println(indent + tee + e.name
+                        + ("/" if e.is_directory else ""))
+            if e.is_directory:
+                dirs += 1
+                rec(d.rstrip("/") + "/" + e.name,
+                    indent + ("    " if last else "│   "))
+            else:
+                files += 1
+
+    rec(root, "")
+    env.println(f"{dirs} directories, {files} files")
+
+
+# -- s3.bucket.*: buckets are directories under /buckets on the filer
+#    (the same convention the S3 gateway serves; gateway/s3.py
+#    BUCKETS_DIR) --
+
+_BUCKETS_DIR = "/buckets"
+
+
+@cluster_command("s3.bucket.list")
+def cmd_s3_bucket_list(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("s3.bucket.list")
+    p.parse_args(argv)
+    fc = _fc(env)
+    n = 0
+    for e in fc.list(_BUCKETS_DIR):
+        if not e.is_directory:
+            continue
+        size = files = 0
+        for _d, sub in _walk(fc, f"{_BUCKETS_DIR}/{e.name}"):
+            if not sub.is_directory:
+                files += 1
+                size += _entry_size(sub)
+        env.println(f"{e.name}  {size} bytes, {files} objects")
+        n += 1
+    env.println(f"{n} buckets")
+
+
+@cluster_command("s3.bucket.create")
+def cmd_s3_bucket_create(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("s3.bucket.create")
+    p.add_argument("-name", required=True)
+    args = p.parse_args(argv)
+    fc = _fc(env)
+    if fc.lookup(_BUCKETS_DIR, args.name) is not None:
+        raise ShellError(f"bucket {args.name} already exists")
+    fc.mkdir(_BUCKETS_DIR, args.name)
+    env.println(f"created bucket {args.name}")
+
+
+@cluster_command("s3.bucket.delete")
+def cmd_s3_bucket_delete(env: ClusterEnv, argv: list[str]) -> None:
+    """Delete a bucket and every object in it (the reference requires
+    the bucket name twice nowhere; -force skips the empty check)."""
+    p = _parser("s3.bucket.delete")
+    p.add_argument("-name", required=True)
+    p.add_argument("-force", action="store_true",
+                   help="delete even when the bucket is not empty")
+    args = p.parse_args(argv)
+    fc = _fc(env)
+    if fc.lookup(_BUCKETS_DIR, args.name) is None:
+        raise ShellError(f"bucket {args.name} not found")
+    if not args.force:
+        if any(True for _ in fc.list(f"{_BUCKETS_DIR}/{args.name}")):
+            raise ShellError(
+                f"bucket {args.name} is not empty (use -force)")
+    fc.delete(_BUCKETS_DIR, args.name, recursive=True,
+              delete_data=True)
+    env.println(f"deleted bucket {args.name}")
+
+
 def _entry_to_json(directory: str, e) -> dict:
     return {
         "dir": directory,

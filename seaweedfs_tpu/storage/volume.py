@@ -336,6 +336,64 @@ class Volume:
             raise VolumeError("cookie mismatch")
         return n
 
+    def read_record(self, key: int) -> tuple[bytes, int]:
+        """Raw on-disk record bytes for a live needle plus its byte
+        offset — the replica-sync read behind volume.check.disk
+        (reference: volume_grpc_read_write.go ReadNeedleBlob, which
+        also hands back the undecoded record)."""
+        with self._lock:
+            while self._swap_pending:
+                self._no_readers.wait()
+            entry = self.nm.get(key)
+            if entry is None:
+                raise KeyError(f"needle {key} not found")
+            if self._dat is None:
+                raise VolumeError("volume not open")
+            dat = self._dat
+            self._readers += 1
+        try:
+            rec = dat.read_at(
+                needle_mod.record_size(entry.size,
+                                       self.super_block.version),
+                entry.byte_offset)
+        finally:
+            with self._lock:
+                self._readers -= 1
+                if not self._readers:
+                    self._no_readers.notify_all()
+        return rec, entry.byte_offset
+
+    def write_raw_record(self, rec: bytes) -> int:
+        """Append a raw record produced by :meth:`read_record` on a
+        sibling replica (WriteNeedleBlob): same append discipline as
+        write_needle, but the bytes are trusted verbatim so CRC and
+        timestamps survive the copy bit-for-bit."""
+        cookie, key, body_size = needle_mod.parse_header(rec)
+        want = needle_mod.record_size(body_size,
+                                      self.super_block.version)
+        if len(rec) != want:
+            raise VolumeError(
+                f"raw record length {len(rec)} != expected {want} "
+                f"for size {body_size}")
+        if self._dat is None:
+            raise VolumeError("volume not open")
+        with self._lock:
+            if self.readonly:
+                raise VolumeError(
+                    f"volume {self.volume_id} is read-only")
+            offset = self._dat.size()
+            if offset % NEEDLE_PADDING_SIZE:
+                pad = (-offset) % NEEDLE_PADDING_SIZE
+                self._dat.write_at(b"\x00" * pad, offset)
+                offset += pad
+            self._dat.write_at(rec, offset)
+            self._dat.flush()
+            units = to_offset_units(offset)
+            self._idx.write(IndexEntry(key, units, body_size).to_bytes())
+            self._idx.flush()
+            self.nm.set(key, units, body_size)
+        return offset
+
     def delete_needle(self, key: int) -> bool:
         with self._lock:
             if self.readonly:

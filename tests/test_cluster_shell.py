@@ -262,3 +262,166 @@ def test_shell_volume_tier_lifecycle(cluster, tmp_path):
             filer.stop()
     finally:
         mc.close()
+
+
+def test_shell_volume_mark_check_delete_empty(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        fids = operation.submit(mc, [b"y" * 800])
+        vid = int(fids[0].split(",")[0])
+        master.grow_volume()  # guarantees at least one empty volume
+        _settle(servers)
+
+        env, out = _env(master)
+        run_cluster_command(env, f"volume.mark -volumeId {vid} -readonly")
+        holders = [vs for vs in servers if vs.store.has_volume(vid)]
+        assert holders and all(("", vid) in vs.store.readonly
+                               for vs in holders)
+        run_cluster_command(env, f"volume.mark -volumeId {vid} -writable")
+        assert all(("", vid) not in vs.store.readonly for vs in holders)
+
+        # healthy cluster -> zero problems
+        run_cluster_command(env, "cluster.check")
+        assert "0 problems" in out.getvalue()
+
+        # dry run reports but does not delete
+        run_cluster_command(env, "volume.deleteEmpty -quietFor 0")
+        assert "dry run" in out.getvalue()
+        # default quiet period protects freshly created volumes
+        before_quiet = sum(len(vs.store.volumes) for vs in servers)
+        run_cluster_command(env, "volume.deleteEmpty -force")
+        _settle(servers)
+        assert sum(len(vs.store.volumes)
+                   for vs in servers) == before_quiet
+        before = sum(len(vs.store.volumes) for vs in servers)
+        run_cluster_command(env, "volume.deleteEmpty -quietFor 0 -force")
+        _settle(servers)
+        after = sum(len(vs.store.volumes) for vs in servers)
+        assert after < before
+        # the volume holding data survived and still serves
+        assert any(vs.store.has_volume(vid) for vs in servers)
+        assert operation.download(mc, fids[0]) == b"y" * 800
+        env.close()
+    finally:
+        mc.close()
+
+
+def test_shell_cluster_check_reports_deficit(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        a = operation.assign(mc, collection="chk", replication="010")
+        operation.upload(a.url, a.fid, b"chk", collection="chk")
+        vid = int(a.fid.split(",")[0])
+        _settle(servers)
+        holder = next(vs for vs in servers
+                      if vs.store.has_volume(vid, "chk"))
+        holder.store.delete_volume(vid, "chk")
+        _settle(servers)
+        env, out = _env(master)
+        with pytest.raises(Exception, match="problems found"):
+            run_cluster_command(env, "cluster.check")
+        assert f"volume {vid} under-replicated" in out.getvalue()
+        run_cluster_command(env, "volume.fix.replication")
+        env.close()
+    finally:
+        mc.close()
+
+
+def test_shell_volume_server_evacuate(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        rng = np.random.default_rng(11)
+        blobs = [rng.integers(0, 256, 1200, dtype=np.uint8).tobytes()
+                 for _ in range(8)]
+        fids = operation.submit(mc, blobs)
+        vid = int(fids[0].split(",")[0])
+        keep = [(f, b) for f, b in zip(fids, blobs)
+                if int(f.split(",")[0]) == vid]
+        env, out = _env(master)
+        # EC-encode so the victim also holds shards to drain.
+        run_cluster_command(env, f"ec.encode -volumeId {vid}")
+        _settle(servers)
+        victim = next(vs for vs in servers
+                      if any(v == vid for (_c, v) in vs.store.ec_mounts))
+        # give the victim a normal volume too
+        a = operation.assign(mc)
+        operation.upload(a.url, a.fid, b"drain-me", jwt=a.auth)
+        _settle(servers)
+
+        run_cluster_command(env,
+                            f"volumeServer.evacuate -node {victim.url}")
+        _settle(servers)
+        time.sleep(2 * PULSE)
+        assert "drained" in out.getvalue()
+        assert not victim.store.volumes
+        assert not any(v == vid for (_c, v) in victim.store.ec_mounts)
+        # every needle still readable (EC reads + moved volumes)
+        mc.invalidate()
+        for f, b in keep:
+            assert operation.download(mc, f) == b
+        assert operation.download(mc, a.fid) == b"drain-me"
+        env.close()
+    finally:
+        mc.close()
+
+
+def test_shell_volume_check_disk(cluster):
+    from seaweedfs_tpu.storage.needle import Needle
+
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        a = operation.assign(mc, collection="cd", replication="010")
+        operation.upload(a.url, a.fid, b"both-see-this",
+                         collection="cd")
+        vid = int(a.fid.split(",")[0])
+        _settle(servers)
+        holders = [vs for vs in servers
+                   if vs.store.has_volume(vid, "cd")]
+        assert len(holders) == 2
+        va, vb = (h.store.get_volume(vid, "cd") for h in holders)
+
+        # in-sync replicas: clean report
+        env, out = _env(master)
+        run_cluster_command(env, "volume.check.disk -collection cd")
+        assert "0 divergent" in out.getvalue()
+
+        # diverge: one replica gains a needle the other missed
+        extra_id = 987654
+        va.write_needle(Needle(cookie=5, id=extra_id,
+                               data=b"only-on-a"))
+        # and one needle is tombstoned on B only (a delete B applied
+        # that never reached A must NOT be resurrected onto B)
+        dead_id = 987655
+        rec_a = va.write_needle(Needle(cookie=6, id=dead_id,
+                                       data=b"deleted-on-b"))
+        assert rec_a is not None
+        vb.write_raw_record(va.read_record(dead_id)[0])
+        vb.delete_needle(dead_id)
+
+        out.truncate(0)
+        run_cluster_command(env, "volume.check.disk -collection cd")
+        assert "dry run" in out.getvalue()
+        assert vb.nm.get(extra_id) is None  # dry run did not write
+
+        run_cluster_command(env,
+                            "volume.check.disk -collection cd -fix")
+        assert "needles synced" in out.getvalue()
+        # the missing needle arrived bit-for-bit
+        assert vb.read_needle(extra_id).data == b"only-on-a"
+        assert va.read_record(extra_id)[0] == vb.read_record(extra_id)[0]
+        # the tombstoned needle stayed dead on B, and the skew is
+        # reported for the operator
+        assert vb.nm.get(dead_id) is None
+        assert "deleted elsewhere" in out.getvalue()
+        # now converged (modulo the reported delete skew)
+        out.truncate(0)
+        run_cluster_command(env, "volume.check.disk -collection cd")
+        assert "0 divergent" in out.getvalue()
+        assert "1 unresolved skews" in out.getvalue()
+        env.close()
+    finally:
+        mc.close()

@@ -189,3 +189,42 @@ def test_grpc_auth_accepts_cluster_key_and_cluster_works(stack):
     # master-driven admin path (its stub carries the token): grow
     vid = master.grow_volume()
     assert vid >= 1
+
+
+def test_fs_tree_and_bucket_commands(stack):
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+
+    _, _, filer = stack
+    fc = FilerClient(filer.url)
+    try:
+        _shell(stack, "s3.bucket.create -name shellbkt")
+        fc.put_data("/buckets/shellbkt/obj1.txt", b"one")
+        fc.put_data("/buckets/shellbkt/sub/obj2.txt", b"twotwo")
+
+        listing = _shell(stack, "s3.bucket.list")
+        assert "shellbkt" in listing and "2 objects" in listing
+
+        tree = _shell(stack, "fs.tree /buckets/shellbkt")
+        assert "obj1.txt" in tree and "sub/" in tree
+        assert "1 directories, 2 files" in tree
+
+        # duplicate create refuses
+        err = None
+        try:
+            _shell(stack, "s3.bucket.create -name shellbkt")
+        except ShellError as e:
+            err = str(e)
+        assert err and "exists" in err
+
+        # non-empty delete refuses without -force
+        err = None
+        try:
+            _shell(stack, "s3.bucket.delete -name shellbkt")
+        except ShellError as e:
+            err = str(e)
+        assert err and "not empty" in err
+
+        _shell(stack, "s3.bucket.delete -name shellbkt -force")
+        assert fc.lookup("/buckets", "shellbkt") is None
+    finally:
+        fc.close()
