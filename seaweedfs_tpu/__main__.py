@@ -107,6 +107,11 @@ def _run_filer_replicate(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_filer_sync(argv: list[str]) -> int:
+    from .replication.filer_sync import main
+    return main(argv)
+
+
 def _run_fix(argv: list[str]) -> int:
     from .volume_tools import run_fix
     return run_fix(argv)
@@ -150,6 +155,7 @@ COMMANDS = {
     "webdav": _run_webdav,
     "mount": _run_mount,
     "filer.replicate": _run_filer_replicate,
+    "filer.sync": _run_filer_sync,
     "fix": _run_fix,
     "export": _run_export,
     "server": _run_server,

@@ -24,6 +24,15 @@ from .master import _grpc_port
 from ..util import tls as tls_mod
 
 
+def _with_signatures(query: str, signatures: tuple) -> str:
+    """Append the loop-prevention chain as a ``signatures=a,b`` query
+    param (the HTTP face of the rpc signatures field)."""
+    if not signatures:
+        return query
+    sig_q = "signatures=" + ",".join(str(x) for x in signatures)
+    return f"{query}&{sig_q}" if query else sig_q
+
+
 class FilerClientError(RuntimeError):
     pass
 
@@ -70,30 +79,42 @@ class FilerClient:
             yield r.entry
 
     def create(self, directory: str, entry: filer_pb2.Entry,
-               o_excl: bool = False) -> None:
+               o_excl: bool = False,
+               signatures: tuple = ()) -> None:
         resp = self._stub().CreateEntry(filer_pb2.CreateEntryRequest(
-            directory=directory, entry=entry, o_excl=o_excl))
+            directory=directory, entry=entry, o_excl=o_excl,
+            signatures=list(signatures)))
         if resp.error:
             raise FilerClientError(resp.error)
 
-    def mkdir(self, directory: str, name: str) -> None:
+    def mkdir(self, directory: str, name: str,
+              signatures: tuple = ()) -> None:
         self.create(directory, filer_pb2.Entry(
             name=name, is_directory=True,
-            attributes=filer_pb2.FuseAttributes(file_mode=0o770)))
+            attributes=filer_pb2.FuseAttributes(file_mode=0o770)),
+            signatures=signatures)
 
     def delete(self, directory: str, name: str, recursive: bool = False,
-               delete_data: bool = True) -> None:
+               delete_data: bool = True,
+               signatures: tuple = ()) -> None:
         resp = self._stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
             directory=directory, name=name, is_recursive=recursive,
-            is_delete_data=delete_data))
+            is_delete_data=delete_data, signatures=list(signatures)))
         if resp.error:
             raise FilerClientError(resp.error)
 
     def rename(self, old_dir: str, old_name: str, new_dir: str,
-               new_name: str) -> None:
+               new_name: str, signatures: tuple = ()) -> None:
         self._stub().AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
             old_directory=old_dir, old_name=old_name,
-            new_directory=new_dir, new_name=new_name))
+            new_directory=new_dir, new_name=new_name,
+            signatures=list(signatures)))
+
+    def configuration(self) -> filer_pb2.GetFilerConfigurationResponse:
+        """The filer's stable signature (+ default collection/
+        replication) — filer.sync's loop-prevention token."""
+        return self._stub().GetFilerConfiguration(
+            filer_pb2.GetFilerConfigurationRequest())
 
     # ---- data (HTTP) ----
 
@@ -103,7 +124,8 @@ class FilerClient:
             (f"?{query}" if query else "")
 
     def put_data(self, path: str, data: bytes, mime: str = "",
-                 query: str = "") -> dict:
+                 query: str = "", signatures: tuple = ()) -> dict:
+        query = _with_signatures(query, signatures)
         req = urllib.request.Request(self._url(path, query), data=data,
                                      method="PUT")
         if mime:
@@ -214,8 +236,10 @@ class FilerClient:
                     f"complete copy preserved at {tmp_path}") from e
         return off
 
-    def delete_data(self, path: str, recursive: bool = False) -> None:
-        q = "recursive=true" if recursive else ""
+    def delete_data(self, path: str, recursive: bool = False,
+                    signatures: tuple = ()) -> None:
+        q = _with_signatures("recursive=true" if recursive else "",
+                             signatures)
         req = urllib.request.Request(self._url(path, q), method="DELETE")
         try:
             with urllib.request.urlopen(req, timeout=120) as r:

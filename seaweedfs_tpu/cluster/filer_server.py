@@ -164,14 +164,16 @@ class _FilerServicer:
         try:
             self.fs.filer.create_entry(
                 pb_to_entry(request.directory, request.entry),
-                o_excl=request.o_excl)
+                o_excl=request.o_excl,
+                signatures=tuple(request.signatures))
         except FilerError as e:
             resp.error = str(e)
         return resp
 
     def UpdateEntry(self, request, context):
         self.fs.filer.update_entry(
-            pb_to_entry(request.directory, request.entry))
+            pb_to_entry(request.directory, request.entry),
+            signatures=tuple(request.signatures))
         return filer_pb2.UpdateEntryResponse()
 
     def DeleteEntry(self, request, context):
@@ -181,10 +183,12 @@ class _FilerServicer:
             if request.is_delete_data and self.fs.master is not None:
                 self.fs.filer.delete_file_and_chunks(
                     path, self.fs.master,
-                    recursive=request.is_recursive)
+                    recursive=request.is_recursive,
+                    signatures=tuple(request.signatures))
             else:
                 self.fs.filer.delete_entry(
-                    path, recursive=request.is_recursive)
+                    path, recursive=request.is_recursive,
+                    signatures=tuple(request.signatures))
         except FilerError as e:
             resp.error = str(e)
         return resp
@@ -192,8 +196,15 @@ class _FilerServicer:
     def AtomicRenameEntry(self, request, context):
         self.fs.filer.rename(
             f"{request.old_directory}/{request.old_name}",
-            f"{request.new_directory}/{request.new_name}")
+            f"{request.new_directory}/{request.new_name}",
+            signatures=tuple(request.signatures))
         return filer_pb2.AtomicRenameEntryResponse()
+
+    def GetFilerConfiguration(self, request, context):
+        return filer_pb2.GetFilerConfigurationResponse(
+            signature=self.fs.filer.signature,
+            collection=self.fs.collection,
+            replication=self.fs.replication)
 
     def SubscribeMetadata(self, request, context):
         stop = threading.Event()
@@ -202,6 +213,7 @@ class _FilerServicer:
         # subscribe wait-loop forever and block process exit.
         context.add_callback(stop.set)
         prefix = request.path_prefix or "/"
+        excluded = set(request.signatures)
         for ev in self.fs.filer.subscribe(stop,
                                           since_ns=request.since_ns,
                                           hello=True):
@@ -215,8 +227,14 @@ class _FilerServicer:
             # use it as an attach barrier + skew-free resume point
             if not is_hello and not (ev.directory + "/").startswith(want):
                 continue
+            # loop-prevention filter: a subscriber names the filers
+            # whose changes it must not see again (filer.sync passes
+            # its apply target's signature)
+            if excluded and excluded & set(ev.signatures):
+                continue
             note = filer_pb2.EventNotification(
                 delete_chunks=ev.new_entry is None)
+            note.signatures.extend(ev.signatures)
             if ev.old_entry is not None:
                 note.old_entry.CopyFrom(entry_to_pb(ev.old_entry))
             if ev.new_entry is not None:
@@ -227,6 +245,18 @@ class _FilerServicer:
 
 
 # ------------- HTTP -------------
+
+
+def _parse_signatures(q: dict) -> tuple:
+    """``signatures=12,34`` query param -> int tuple (the HTTP face of
+    the rpc signatures field; non-numeric values are ignored)."""
+    raw = q.get("signatures", "")
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part.lstrip("-").isdigit():
+            out.append(int(part))
+    return tuple(out)
 
 def _make_http_handler(fs: FilerServer):
     class Handler(BaseHTTPRequestHandler):
@@ -319,7 +349,8 @@ def _make_http_handler(fs: FilerServer):
             if q.get("mkdir") == "true" or self.path.rstrip("?").endswith(
                     "/") and not self._body_expected():
                 fs.filer.create_entry(Entry(
-                    path=path, attr=Attr(is_dir=True, mode=0o770)))
+                    path=path, attr=Attr(is_dir=True, mode=0o770)),
+                    signatures=_parse_signatures(q))
                 self._send(201, b"{}")
                 return
             if fs.master is None:
@@ -343,7 +374,8 @@ def _make_http_handler(fs: FilerServer):
                         "multipart/") else "",
                     chunk_size=int(q["maxMB"]) * 1024 * 1024
                     if "maxMB" in q else None,
-                    append=q.get("op") == "append")
+                    append=q.get("op") == "append",
+                    signatures=_parse_signatures(q))
             except FilerError as e:
                 self._err(409, str(e))
                 return
@@ -358,12 +390,15 @@ def _make_http_handler(fs: FilerServer):
             path, q = self._path()
             fs.metrics.counter("request_total", method="DELETE").inc()
             recursive = q.get("recursive") == "true"
+            sigs = _parse_signatures(q)
             try:
                 if fs.master is not None:
                     fs.filer.delete_file_and_chunks(path, fs.master,
-                                                    recursive=recursive)
+                                                    recursive=recursive,
+                                                    signatures=sigs)
                 else:
-                    fs.filer.delete_entry(path, recursive=recursive)
+                    fs.filer.delete_entry(path, recursive=recursive,
+                                          signatures=sigs)
             except FilerError as e:
                 self._err(404 if "not found" in str(e) else 409, str(e))
                 return

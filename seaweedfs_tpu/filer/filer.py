@@ -47,6 +47,10 @@ class MetaEvent:
     directory: str
     old_entry: Optional[Entry]
     new_entry: Optional[Entry]
+    #: Loop-prevention chain (reference filer.proto ``signatures``):
+    #: ids of every filer this mutation has visited, origin first;
+    #: the emitting filer's own signature is always the last element.
+    signatures: tuple = ()
 
 
 @dataclass
@@ -74,8 +78,15 @@ class Filer:
     #: without limit — past this, its events drop and its stream errors.
     MAX_SUB_QUEUE = 10_000
 
-    def __init__(self, store: Optional[FilerStore] = None):
+    def __init__(self, store: Optional[FilerStore] = None,
+                 signature: int = 0):
         self.store = store or MemoryStore()
+        #: Stable per-filer id for replication loop prevention
+        #: (reference: the filer store mints and PERSISTS a random
+        #: signature, so a restart keeps its identity and a running
+        #: filer.sync's exclude filters stay valid). Nonzero int31;
+        #: persisted through the store's kv seam.
+        self.signature = signature or self._load_or_mint_signature()
         self._subs: list[_Subscriber] = []
         self._meta_log: collections.deque[MetaEvent] = collections.deque(
             maxlen=self.META_LOG_EVENTS)
@@ -85,6 +96,18 @@ class Filer:
         # HTTP handler and the gRPC worker pool.
         self._ns_lock = threading.RLock()
 
+    def _load_or_mint_signature(self) -> int:
+        import random as _random
+        raw = self.store.kv_get("filer.signature")
+        if raw:
+            try:
+                return int(raw.decode()) or 1
+            except ValueError:
+                pass
+        sig = _random.getrandbits(31) or 1
+        self.store.kv_put("filer.signature", str(sig).encode())
+        return sig
+
     # ------------- namespace -------------
 
     def find_entry(self, path: str) -> Optional[Entry]:
@@ -93,8 +116,8 @@ class Filer:
             return Entry(path="/", attr=Attr(is_dir=True))
         return self.store.find_entry(path)
 
-    def create_entry(self, entry: Entry,
-                     o_excl: bool = False) -> Entry:
+    def create_entry(self, entry: Entry, o_excl: bool = False,
+                     signatures: tuple = ()) -> Entry:
         path = normalize_path(entry.path)
         if path == "/":
             raise FilerError("cannot create /")
@@ -108,22 +131,24 @@ class Filer:
                     raise FilerError(
                         f"{path} exists as a "
                         f"{'directory' if old.is_dir else 'file'}")
-            self._ensure_parents(path)
+            self._ensure_parents(path, signatures)
             self.store.insert_entry(entry)
-        self._notify(entry.parent, old, entry)
+        self._notify(entry.parent, old, entry, signatures)
         return entry
 
-    def update_entry(self, entry: Entry) -> Entry:
+    def update_entry(self, entry: Entry,
+                     signatures: tuple = ()) -> Entry:
         path = normalize_path(entry.path)
         with self._ns_lock:
             old = self.store.find_entry(path)
             if old is None:
                 raise FilerError(f"{path} not found")
             self.store.update_entry(entry)
-        self._notify(entry.parent, old, entry)
+        self._notify(entry.parent, old, entry, signatures)
         return entry
 
-    def _ensure_parents(self, path: str) -> None:
+    def _ensure_parents(self, path: str,
+                        signatures: tuple = ()) -> None:
         parent, _ = split_path(path)
         missing: list[str] = []
         while parent != "/":
@@ -137,14 +162,14 @@ class Filer:
         for p in reversed(missing):
             d = Entry(path=p, attr=Attr(is_dir=True, mode=0o770))
             self.store.insert_entry(d)
-            self._notify(split_path(p)[0], None, d)
+            self._notify(split_path(p)[0], None, d, signatures)
 
     def list_entries(self, dir_path: str, start_name: str = "",
                      limit: int = 1 << 30) -> Iterator[Entry]:
         return self.store.list_entries(dir_path, start_name, limit)
 
-    def delete_entry(self, path: str, recursive: bool = False
-                     ) -> list[FileChunk]:
+    def delete_entry(self, path: str, recursive: bool = False,
+                     signatures: tuple = ()) -> list[FileChunk]:
         """Remove an entry; returns every chunk orphaned by the delete so
         the caller can reclaim blob space (filer_delete_entry.go)."""
         path = normalize_path(path)
@@ -158,15 +183,17 @@ class Filer:
                 if children and not recursive:
                     raise FilerError(f"{path} is not empty")
                 for child in children:
-                    orphans.extend(self.delete_entry(child.path,
-                                                     recursive=True))
+                    orphans.extend(self.delete_entry(
+                        child.path, recursive=True,
+                        signatures=signatures))
             else:
                 orphans.extend(entry.chunks)
             self.store.delete_entry(path)
-        self._notify(split_path(path)[0], entry, None)
+        self._notify(split_path(path)[0], entry, None, signatures)
         return orphans
 
-    def rename(self, old_path: str, new_path: str) -> Entry:
+    def rename(self, old_path: str, new_path: str,
+               signatures: tuple = ()) -> Entry:
         """Move one entry (file or empty-subtree root moves only the
         node itself for directories whose children stay keyed under the
         new prefix via recursion)."""
@@ -180,27 +207,31 @@ class Filer:
                 for child in list(self.store.list_entries(old_path)):
                     self.rename(
                         child.path,
-                        new_path + "/" + split_path(child.path)[1])
+                        new_path + "/" + split_path(child.path)[1],
+                        signatures=signatures)
             moved = entry.clone()
             moved.path = new_path
-            self._ensure_parents(new_path)
+            self._ensure_parents(new_path, signatures)
             self.store.insert_entry(moved)
             self.store.delete_entry(old_path)
-        self._notify(split_path(old_path)[0], entry, None)
-        self._notify(split_path(new_path)[0], None, moved)
+        self._notify(split_path(old_path)[0], entry, None, signatures)
+        self._notify(split_path(new_path)[0], None, moved, signatures)
         return moved
 
     # ------------- meta-log / subscribe -------------
 
     def _notify(self, directory: str, old: Optional[Entry],
-                new: Optional[Entry]) -> None:
+                new: Optional[Entry],
+                signatures: tuple = ()) -> None:
         with self._lock:
             # Stamp under the lock: timestamp order == log order, so a
             # subscriber's attach stamp (hello_ts, taken under this
             # same lock) is a true barrier — every event appended after
             # registration carries ts >= it.
             ev = MetaEvent(ts_ns=time.time_ns(), directory=directory,
-                           old_entry=old, new_entry=new)
+                           old_entry=old, new_entry=new,
+                           signatures=tuple(signatures)
+                           + (self.signature,))
             self._meta_log.append(ev)
             subs = list(self._subs)
         for s in subs:
@@ -288,7 +319,8 @@ class Filer:
     def write_file(self, path: str, data: bytes, master,
                    collection: str = "", replication: str = "",
                    mime: str = "", chunk_size: Optional[int] = None,
-                   append: bool = False) -> Entry:
+                   append: bool = False,
+                   signatures: tuple = ()) -> Entry:
         """Split ``data`` into chunks, upload each (assign + POST), then
         commit the entry — the §3.2 write stack driven from the filer."""
         from ..cluster import operation
@@ -326,7 +358,7 @@ class Filer:
                             replication=replication, mime=mime)
             attr.mtime = time.time()
             entry = Entry(path=path, attr=attr, chunks=chunks)
-            self.create_entry(entry)
+            self.create_entry(entry, signatures=signatures)
         if current is not None and not append:
             new_ids = {c.file_id for c in chunks}
             stale = [c for c in current.chunks
@@ -359,12 +391,14 @@ class Filer:
         return bytes(buf)
 
     def delete_file_and_chunks(self, path: str, master,
-                               recursive: bool = False) -> None:
+                               recursive: bool = False,
+                               signatures: tuple = ()) -> None:
         entry = self.find_entry(path)
         if entry is None:
             raise FilerError(f"{path} not found")
         col = entry.attr.collection
-        orphans = self.delete_entry(path, recursive=recursive)
+        orphans = self.delete_entry(path, recursive=recursive,
+                                    signatures=signatures)
         self._delete_chunks_via(master, orphans, col)
 
     @staticmethod

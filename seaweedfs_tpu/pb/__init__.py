@@ -133,6 +133,9 @@ FILER_METHODS = [
            filer_pb2.AtomicRenameEntryResponse),
     Method("SubscribeMetadata", filer_pb2.SubscribeMetadataRequest,
            filer_pb2.SubscribeMetadataResponse, SERVER_STREAM),
+    Method("GetFilerConfiguration",
+           filer_pb2.GetFilerConfigurationRequest,
+           filer_pb2.GetFilerConfigurationResponse),
 ]
 
 

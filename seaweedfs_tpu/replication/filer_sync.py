@@ -1,0 +1,124 @@
+"""Bidirectional (active-active) filer synchronization.
+
+The ``weed filer.sync`` analog (reference: weed/command/filer_sync.go):
+two :class:`~seaweedfs_tpu.replication.replicator.Replicator` legs, one
+per direction, with the reference's signature-chain loop prevention —
+every mutation event carries the ids of the filers it has visited
+(``EventNotification.signatures``), each leg subscribes excluding its
+TARGET's signature, and sinks forward the chain on apply so the target
+filer appends itself. A change born on A therefore travels A→B once and
+dies at B→A's subscribe filter; same for B-born changes mirrored.
+
+Conflict policy matches the reference's default: last-writer-wins per
+path at apply time (each leg simply applies what it sees; there is no
+vector-clock merge), which is convergent for the common
+distinct-paths/active-standby cases and documented as such.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..cluster.filer_client import FilerClient
+from ..util import glog
+from ..util import tls as tls_mod
+from .replicator import Replicator
+from .sinks import FilerSink
+
+
+def _signature_of(filer_url: str) -> int:
+    c = FilerClient(filer_url)
+    try:
+        return c.configuration().signature
+    finally:
+        c.close()
+
+
+class FilerSync:
+    """Two replicator legs joined by their peers' signatures."""
+
+    def __init__(self, filer_a: str, filer_b: str,
+                 path_prefix: str = "/",
+                 bootstrap: bool = True):
+        self.filer_a = filer_a
+        self.filer_b = filer_b
+        sig_a = _signature_of(filer_a)
+        sig_b = _signature_of(filer_b)
+        if sig_a == sig_b:
+            raise RuntimeError(
+                f"filers {filer_a} and {filer_b} share signature "
+                f"{sig_a}; refusing to sync a filer with itself")
+        self.a2b = Replicator(
+            filer_a, FilerSink(filer_a, filer_b),
+            path_prefix=path_prefix, client_name=f"sync->{filer_b}",
+            bootstrap=bootstrap, exclude_signatures=(sig_b,))
+        self.b2a = Replicator(
+            filer_b, FilerSink(filer_b, filer_a),
+            path_prefix=path_prefix, client_name=f"sync->{filer_a}",
+            bootstrap=bootstrap, exclude_signatures=(sig_a,))
+        # One condition serves both legs so wait_converged wakes on
+        # applies from EITHER direction (each leg notifies its own
+        # applied_cond; aliasing them pre-start makes that one object).
+        self.b2a.applied_cond = self.a2b.applied_cond
+
+    def start(self, wait_attach: float = 10.0) -> "FilerSync":
+        self.a2b.start(wait_attach=wait_attach)
+        self.b2a.start(wait_attach=wait_attach)
+        return self
+
+    def stop(self) -> None:
+        self.a2b.stop()
+        self.b2a.stop()
+
+    def wait_converged(self, pred, timeout: float = 45.0) -> bool:
+        """Re-check ``pred`` after applies on EITHER leg (both legs
+        notify the shared applied_cond); the deadline is a failsafe,
+        not the synchronization mechanism."""
+        cond = self.a2b.applied_cond
+
+        def total():
+            return (self.a2b.applied + self.a2b.errors
+                    + self.b2a.applied + self.b2a.errors)
+
+        deadline = time.monotonic() + timeout
+        while True:
+            with cond:
+                n = total()
+            if pred():
+                return True
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return bool(pred())
+            with cond:
+                cond.wait_for(lambda: total() != n,
+                              timeout=min(left, 1.0))
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """``python -m seaweedfs_tpu filer.sync`` — keep two filers in
+    active-active sync."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="filer.sync")
+    p.add_argument("-a", required=True, help="first filer host:port")
+    p.add_argument("-b", required=True, help="second filer host:port")
+    p.add_argument("-path", default="/", help="sync only this subtree")
+    p.add_argument("-noBootstrap", action="store_true",
+                   help="skip the initial two-way tree walk")
+    p.add_argument("-config", default="",
+                   help="security.toml ([grpc.tls] client credentials)")
+    args = p.parse_args(argv)
+    from ..util import config as config_mod
+    tls_mod.install_from_config(
+        config_mod.load(args.config) if args.config else {})
+    sync = FilerSync(args.a, args.b, path_prefix=args.path,
+                     bootstrap=not args.noBootstrap).start()
+    glog.info("filer.sync: %s <-> %s (prefix %s)", args.a, args.b,
+              args.path)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        sync.stop()
+    return 0

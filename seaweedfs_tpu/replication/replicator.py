@@ -66,8 +66,8 @@ class _WalkHold:
                         "re-sync required")
                 self._err = err
                 if err is None:
-                    for path, new, old, ts in self._buffer:
-                        rep._apply(path, new, old)
+                    for path, new, old, ts, sigs in self._buffer:
+                        rep._apply(path, new, old, sigs)
                         rep.last_ts_ns = max(rep.last_ts_ns, ts)
                 self._buffer.clear()
             if err is not None:
@@ -88,7 +88,7 @@ class _WalkHold:
         old flush would interleave with the new attach)."""
         self._thread.join(timeout)
 
-    def offer(self, path, new, old, ts_ns) -> bool:
+    def offer(self, path, new, old, ts_ns, signatures=()) -> bool:
         """Buffer an event if the walk is still running; False once the
         walk (and the buffered flush) completed."""
         with self._lock:
@@ -96,7 +96,8 @@ class _WalkHold:
                 if len(self._buffer) >= self.MAX_BUFFER:
                     self._overflow = True
                 else:
-                    self._buffer.append((path, new, old, ts_ns))
+                    self._buffer.append((path, new, old, ts_ns,
+                                         tuple(signatures)))
                 return True
             return False
 
@@ -109,12 +110,22 @@ class Replicator:
     def __init__(self, source_filer_url: str, sink: ReplicationSink,
                  path_prefix: str = "/",
                  client_name: str = "replicator",
-                 bootstrap: bool = True):
+                 bootstrap: bool = True,
+                 exclude_signatures: tuple = ()):
         self.source_url = source_filer_url
         self.sink = sink
         self.path_prefix = "/" + path_prefix.strip("/")
         self.client_name = client_name
         self.bootstrap = bootstrap
+        #: Events whose chain contains any of these are skipped — a
+        #: filer.sync leg passes its TARGET's signature so changes the
+        #: other leg applied are not echoed back (the source also
+        #: filters server-side; this is the client-side belt).
+        self.exclude_signatures = tuple(exclude_signatures)
+        #: The source filer's own signature (fetched at dial): the
+        #: bootstrap walk stamps applies with it so walk-copied
+        #: entries carry a truthful origin chain too.
+        self.source_signature = 0
         #: Source-clock resume point: the ts of the newest applied event
         #: or, before any event, the hello stamp adopted at attach (the
         #: source filer's clock under its log lock) — never this host's
@@ -215,21 +226,26 @@ class Replicator:
 
     def _bootstrap(self) -> None:
         src = FilerClient(self.source_url)
+        origin = (self.source_signature,) if self.source_signature \
+            else ()
         try:
             stack = [self.path_prefix]
             while stack and not self._stop.is_set():
                 d = stack.pop()
                 for e in src.list(d):
                     p = (d.rstrip("/") + "/" + e.name)
-                    self._apply(p, e)  # per-entry errors never abort
+                    # per-entry errors never abort
+                    self._apply(p, e, signatures=origin)
                     if e.is_directory:
                         stack.append(p)
         finally:
             src.close()
 
-    def _apply(self, path: str, new_entry, old_entry=None) -> None:
+    def _apply(self, path: str, new_entry, old_entry=None,
+               signatures: tuple = ()) -> None:
         try:
-            self.sink.apply(path, new_entry, old_entry)
+            self.sink.apply(path, new_entry, old_entry,
+                            signatures=signatures)
             with self.applied_cond:
                 self.applied += 1
                 self.applied_cond.notify_all()
@@ -270,11 +286,19 @@ class Replicator:
         # signature check). last_ts_ns == 0 means attach live-only and
         # adopt the hello stamp (the source's clock at registration).
         live_only = self.last_ts_ns == 0
-        stream = self._stub().SubscribeMetadata(
+        stub = self._stub()
+        if not self.source_signature:
+            try:
+                self.source_signature = stub.GetFilerConfiguration(
+                    filer_pb2.GetFilerConfigurationRequest()).signature
+            except Exception:  # noqa: BLE001 — older source; walk
+                pass           # applies then carry an empty chain
+        stream = stub.SubscribeMetadata(
             filer_pb2.SubscribeMetadataRequest(
                 client_name=self.client_name,
                 path_prefix=self.path_prefix,
-                since_ns=0 if live_only else max(0, self.last_ts_ns - 1)))
+                since_ns=0 if live_only else max(0, self.last_ts_ns - 1),
+                signatures=list(self.exclude_signatures)))
         hold: Optional[_WalkHold] = None
         try:
             for resp in stream:
@@ -306,12 +330,19 @@ class Replicator:
                         on_attach = None
                     continue
                 path = resp.directory.rstrip("/") + "/" + name
+                sigs = tuple(note.signatures)
+                if self.exclude_signatures and \
+                        set(self.exclude_signatures) & set(sigs):
+                    # belt to the server-side filter: never apply a
+                    # change that already visited the target
+                    self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
+                    continue
                 if hold is not None:
-                    if hold.offer(path, new, old, resp.ts_ns):
+                    if hold.offer(path, new, old, resp.ts_ns, sigs):
                         continue  # buffered; applied after the walk
                     hold.raise_if_failed()
                     hold = None
-                self._apply(path, new, old)
+                self._apply(path, new, old, sigs)
                 self.last_ts_ns = max(self.last_ts_ns, resp.ts_ns)
         finally:
             # the walk survives a stream break (it rides its own HTTP

@@ -37,10 +37,14 @@ def _src_signature(entry) -> bytes:
 
 
 class ReplicationSink:
-    """One replication target. ``apply`` receives the source path and
-    the entry's new state (None = deleted)."""
+    """One replication target. ``apply`` receives the source path, the
+    entry's new state (None = deleted), and the mutation's signature
+    chain (the filers it has already visited) — sinks that mutate
+    another filer forward the chain so loops die at the subscribe
+    filter."""
 
-    def apply(self, path: str, new_entry, old_entry=None) -> None:
+    def apply(self, path: str, new_entry, old_entry=None,
+              signatures: tuple = ()) -> None:
         raise NotImplementedError
 
     def close(self) -> None:
@@ -60,17 +64,18 @@ class FilerSink(ReplicationSink):
             return path
         return self.dst_prefix + path
 
-    def apply(self, path: str, new_entry, old_entry=None) -> None:
+    def apply(self, path: str, new_entry, old_entry=None,
+              signatures: tuple = ()) -> None:
         dst_path = self._dst_path(path)
         if new_entry is None:
             try:
-                self.dst.delete_data(dst_path)
+                self.dst.delete_data(dst_path, signatures=signatures)
             except FilerClientError as e:
                 glog.v(1, "replication: delete %s: %s", dst_path, e)
             return
         d, _, n = dst_path.rpartition("/")
         if new_entry.is_directory:
-            self.dst.mkdir(d or "/", n)
+            self.dst.mkdir(d or "/", n, signatures=signatures)
             # carry the directory's mode/xattrs like the file path does
             dup = self.dst.lookup(d or "/", n)
             if dup is not None and (new_entry.attributes.file_mode
@@ -80,7 +85,7 @@ class FilerSink(ReplicationSink):
                         new_entry.attributes.file_mode
                 for k, v in new_entry.extended.items():
                     dup.extended[k] = v
-                self.dst.create(d or "/", dup)
+                self.dst.create(d or "/", dup, signatures=signatures)
             return
         size = _entry_size(new_entry)
         # Idempotence: the destination entry remembers which source
@@ -88,12 +93,21 @@ class FilerSink(ReplicationSink):
         # content, skip (bootstrap + replay overlap is then free).
         sig = _src_signature(new_entry)
         existing = self.dst.lookup(d or "/", n)
-        if existing is not None and not existing.is_directory and \
-                existing.extended.get("replication.src_sig") == sig:
-            return
+        if existing is not None and not existing.is_directory:
+            if existing.extended.get("replication.src_sig") == sig:
+                return
+            # Reverse link: the SOURCE entry is itself a copy of what
+            # the destination holds right now (its src_sig names the
+            # destination's chunk manifest) — same bytes, skip. This
+            # keeps a filer.sync bootstrap walk from re-copying every
+            # entry the opposite leg just delivered.
+            if new_entry.extended.get("replication.src_sig") == \
+                    _src_signature(existing):
+                return
         data = self.src.get_data(path) if size else b""
         self.dst.put_data(dst_path, data,
-                          mime=new_entry.attributes.mime)
+                          mime=new_entry.attributes.mime,
+                          signatures=signatures)
         # carry attributes (mode, mtime) + the signature onto the entry
         dup = self.dst.lookup(d or "/", n)
         if dup is not None:
@@ -102,7 +116,7 @@ class FilerSink(ReplicationSink):
             for k, v in new_entry.extended.items():
                 dup.extended[k] = v
             dup.extended["replication.src_sig"] = sig
-            self.dst.create(d or "/", dup)
+            self.dst.create(d or "/", dup, signatures=signatures)
 
     def close(self) -> None:
         self.src.close()
@@ -171,7 +185,10 @@ class S3Sink(ReplicationSink):
             raise FilerClientError(
                 f"s3 {method} {url}: {e.code}") from e
 
-    def apply(self, path: str, new_entry, old_entry=None) -> None:
+    def apply(self, path: str, new_entry, old_entry=None,
+              signatures: tuple = ()) -> None:
+        # signatures unused: an S3 endpoint emits no meta events, so
+        # nothing can loop back through it
         if new_entry is None:
             self._pushed.pop(path, None)
             self._request("DELETE", path)

@@ -747,7 +747,13 @@ def cmd_volume_delete_empty(env: ClusterEnv, argv: list[str]) -> None:
     args = p.parse_args(argv)
     resp = env.volume_list()
     now = int(time_mod.time())
-    empties: list[tuple[int, str, str]] = []  # (vid, collection, node)
+    # (collection, vid) -> [holder urls]; a volume counts once however
+    # many replicas it has, and ANY replica that is non-empty or
+    # recently modified disqualifies the whole volume (replica state is
+    # heartbeat-stale and may disagree — be conservative before a
+    # destructive sweep).
+    holders: dict[tuple[str, int], list[str]] = {}
+    disqualified: set[tuple[str, int]] = set()
     for dc in resp.topology_info.data_center_infos:
         for rack in dc.rack_infos:
             for dn in rack.data_node_infos:
@@ -755,20 +761,24 @@ def cmd_volume_delete_empty(env: ClusterEnv, argv: list[str]) -> None:
                     if args.collection and \
                             v.collection != args.collection:
                         continue
+                    key = (v.collection, v.id)
+                    holders.setdefault(key, []).append(dn.id)
                     if v.file_count - v.delete_count > 0:
-                        continue
+                        disqualified.add(key)
                     # unknown mtime (0) is never "quiet"
                     if not v.modified_at_second or \
                             now - v.modified_at_second < args.quietFor:
-                        continue
-                    empties.append((v.id, v.collection, dn.id))
-    for vid, col, url in empties:
-        if args.force:
-            env.volume(url).VolumeDelete(
-                volume_server_pb2.VolumeDeleteRequest(
-                    volume_id=vid, collection=col))
-        env.println(f"volume.deleteEmpty: volume {vid} on {url}"
-                    + ("" if args.force else " (dry run; use -force)"))
+                        disqualified.add(key)
+    empties = sorted(k for k in holders if k not in disqualified)
+    for col, vid in empties:
+        for url in holders[(col, vid)]:
+            if args.force:
+                env.volume(url).VolumeDelete(
+                    volume_server_pb2.VolumeDeleteRequest(
+                        volume_id=vid, collection=col))
+            env.println(
+                f"volume.deleteEmpty: volume {vid} on {url}"
+                + ("" if args.force else " (dry run; use -force)"))
     env.println(f"volume.deleteEmpty: {len(empties)} empty volumes"
                 + (" deleted" if args.force else " found"))
 
@@ -786,6 +796,7 @@ def cmd_volume_server_evacuate(env: ClusterEnv, argv: list[str]) -> None:
     resp = env.volume_list()
     counts: dict[str, int] = {}   # node url -> volume count
     caps: dict[str, int] = {}     # node url -> max volume count (0 = inf)
+    racks: dict[str, tuple[str, str]] = {}  # node url -> (dc, rack)
     holds: dict[str, set[tuple[str, int]]] = {}
     victim_vols: list = []
     for dc in resp.topology_info.data_center_infos:
@@ -793,6 +804,7 @@ def cmd_volume_server_evacuate(env: ClusterEnv, argv: list[str]) -> None:
             for dn in rack.data_node_infos:
                 counts[dn.id] = dn.volume_count
                 caps[dn.id] = dn.max_volume_count
+                racks[dn.id] = (dc.id, rack.id)
                 holds[dn.id] = {(v.collection, v.id)
                                 for v in dn.volume_infos}
                 if dn.id == victim:
@@ -805,18 +817,29 @@ def cmd_volume_server_evacuate(env: ClusterEnv, argv: list[str]) -> None:
 
     moved = 0
     for v in victim_vols:
-        # most free slots first, never onto a full node (the reference
-        # evacuate places by free capacity, not raw volume count)
-        targets = sorted(
-            (u for u in counts
-             if u != victim and has_slot(u)
-             and (v.collection, v.id) not in holds[u]),
-            key=lambda u: counts[u] - (caps[u] or 10 ** 9))
-        if not targets:
+        # Racks holding the volume's OTHER replicas: landing on one of
+        # them would collapse a rack-spread placement like 010, so such
+        # targets only qualify as a last resort (with a warning) — the
+        # reference evacuate is placement-aware the same way.
+        other_racks = {racks[u] for u in counts
+                       if u != victim and (v.collection, v.id)
+                       in holds[u]}
+        candidates = [u for u in counts
+                      if u != victim and has_slot(u)
+                      and (v.collection, v.id) not in holds[u]]
+        # placement safety first, then most free slots
+        candidates.sort(key=lambda u: (racks[u] in other_racks,
+                                       counts[u] - (caps[u] or 10 ** 9)))
+        if not candidates:
             raise ShellError(
                 f"volumeServer.evacuate: no target with free space "
                 f"for volume {v.id}")
-        dst = targets[0]
+        dst = candidates[0]
+        if other_racks and racks[dst] in other_racks:
+            env.println(
+                f"volumeServer.evacuate: WARNING volume {v.id} lands "
+                f"on rack {racks[dst][1]} which already holds a "
+                f"replica (no rack-safe target had free space)")
         _move_volume(env, v.id, v.collection, victim, dst)
         counts[dst] += 1
         holds[dst].add((v.collection, v.id))
@@ -938,12 +961,16 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
                 env.println(
                     f"volume {vid} needle {k}: live on "
                     f"{', '.join(holders_live)} but deleted elsewhere")
-        # Same key live everywhere but with different sizes = a missed
-        # overwrite; the idx alone cannot say which side is newer, so
-        # report it (never auto-pick a winner).
+        # Same key live with different sizes = a missed overwrite; the
+        # idx alone cannot say which side is newer, so report it and
+        # keep it OUT of the sync loop below (copying an arbitrary
+        # version would auto-pick the winner this command promises
+        # never to pick).
+        size_skewed: set[int] = set()
         for k in sorted(union - all_dead):
             sizes = {maps[u][k] for u in urls if k in maps[u]}
             if len(sizes) > 1:
+                size_skewed.add(k)
                 skews += 1
                 env.println(
                     f"volume {vid} needle {k}: size differs across "
@@ -952,7 +979,7 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
         # copying one onto a replica that never held it would spread a
         # client-deleted needle (the skew report above covers them).
         for u in urls:
-            missing = [k for k in union - all_dead
+            missing = [k for k in union - all_dead - size_skewed
                        if k not in maps[u]]
             if not missing:
                 continue

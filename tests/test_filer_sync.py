@@ -1,0 +1,169 @@
+"""Bidirectional filer sync (weed filer.sync analog): signature-chain
+loop prevention end to end — changes travel exactly one hop, both
+directions, and never echo."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.filer_client import FilerClient
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.replication.filer_sync import FilerSync
+from seaweedfs_tpu.storage.store import Store
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture()
+def sync_stack(tmp_path):
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=9,
+                          garbage_threshold=0).start()
+    d = tmp_path / "vol"
+    d.mkdir()
+    vs = VolumeServer(Store([d], max_volumes=16),
+                      port=_free_port_pair(), master_url=master.url,
+                      pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 1:
+        time.sleep(0.05)
+    fa = FilerServer(Filer(), port=_free_port_pair(),
+                     master_url=master.url).start()
+    fb = FilerServer(Filer(), port=_free_port_pair(),
+                     master_url=master.url).start()
+    yield master, fa, fb
+    fb.stop()
+    fa.stop()
+    vs.stop()
+    master.stop()
+
+
+def _converge(sync, pred, what, timeout=45.0):
+    if not sync.wait_converged(pred, timeout=timeout):
+        raise AssertionError(f"timed out waiting for {what}")
+
+
+def _quiesce(fa, fb, settle=1.0):
+    """Assert the meta logs stop growing (no replication ping-pong):
+    event counts identical across a settle window."""
+    def counts():
+        return (len(fa.filer._meta_log), len(fb.filer._meta_log))
+    before = counts()
+    time.sleep(settle)
+    after = counts()
+    assert before == after, (
+        f"meta logs still growing after convergence: {before} -> "
+        f"{after} (replication echo loop)")
+
+
+def test_event_signatures_chain(sync_stack):
+    """Unit-ish: mutations stamp the origin chain + the filer's own
+    signature; the subscribe filter excludes chains by member."""
+    _, fa, _ = sync_stack
+    f = fa.filer
+    assert f.signature > 0
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+    f.create_entry(Entry(path="/sig/x", attr=Attr()),
+                   signatures=(1234,))
+    ev = f._meta_log[-1]
+    assert ev.signatures == (1234, f.signature)
+
+
+def test_bidirectional_sync_no_echo(sync_stack):
+    _, fa, fb = sync_stack
+    ca, cb = FilerClient(fa.url), FilerClient(fb.url)
+    sync = FilerSync(fa.url, fb.url).start()
+    try:
+        # A-born change appears on B
+        ca.put_data("/sync/a.txt", b"born-on-a")
+        _converge(sync, lambda: fb.filer.find_entry("/sync/a.txt")
+                  is not None, "a.txt on B")
+        assert cb.get_data("/sync/a.txt") == b"born-on-a"
+
+        # B-born change appears on A
+        cb.put_data("/sync/b.txt", b"born-on-b")
+        _converge(sync, lambda: fa.filer.find_entry("/sync/b.txt")
+                  is not None, "b.txt on A")
+        assert ca.get_data("/sync/b.txt") == b"born-on-b"
+
+        # overwrite on B propagates to A
+        cb.put_data("/sync/a.txt", b"rewritten-on-b")
+        _converge(sync, lambda: ca.get_data("/sync/a.txt")
+                  == b"rewritten-on-b", "rewrite on A")
+
+        # delete on A propagates to B
+        ca.delete_data("/sync/b.txt")
+        _converge(sync, lambda: fb.filer.find_entry("/sync/b.txt")
+                  is None, "delete on B")
+
+        # and the cluster goes quiet: no echo storm
+        _quiesce(fa, fb)
+    finally:
+        sync.stop()
+        ca.close()
+        cb.close()
+
+
+def test_sync_bootstrap_merges_both_trees(sync_stack):
+    _, fa, fb = sync_stack
+    ca, cb = FilerClient(fa.url), FilerClient(fb.url)
+    try:
+        ca.put_data("/boot/only-a.txt", b"aaa")
+        cb.put_data("/boot/only-b.txt", b"bbb")
+        sync = FilerSync(fa.url, fb.url).start()
+        try:
+            _converge(sync, lambda: (
+                fa.filer.find_entry("/boot/only-b.txt") is not None
+                and fb.filer.find_entry("/boot/only-a.txt") is not None),
+                "bootstrap merge")
+            assert cb.get_data("/boot/only-a.txt") == b"aaa"
+            assert ca.get_data("/boot/only-b.txt") == b"bbb"
+            _quiesce(fa, fb)
+        finally:
+            sync.stop()
+    finally:
+        ca.close()
+        cb.close()
+
+
+def test_sync_refuses_same_filer(sync_stack):
+    _, fa, _ = sync_stack
+    with pytest.raises(RuntimeError, match="refusing"):
+        FilerSync(fa.url, fa.url)
+
+
+def test_signature_persists_across_restart(tmp_path):
+    from seaweedfs_tpu.filer.stores import SqliteStore
+
+    db = str(tmp_path / "filer.db")
+    s1 = SqliteStore(db)
+    f1 = Filer(s1)
+    sig = f1.signature
+    assert sig > 0
+    s1.close()
+    s2 = SqliteStore(db)
+    f2 = Filer(s2)
+    assert f2.signature == sig, (
+        "a restarted filer must keep its signature or running "
+        "filer.sync exclude filters break")
+    s2.close()

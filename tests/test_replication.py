@@ -288,7 +288,7 @@ def test_walkhold_buffers_and_flushes_in_order():
             self.applied_paths = []
             self.last_ts_ns = 0
 
-        def _apply(self, path, new, old):
+        def _apply(self, path, new, old, signatures=()):
             self.applied_paths.append(path)
 
     import threading
@@ -312,7 +312,7 @@ def test_walkhold_overflow_demands_resync_and_drops_nothing_silently():
     class Rep:
         last_ts_ns = 0
 
-        def _apply(self, path, new, old):
+        def _apply(self, path, new, old, signatures=()):
             raise AssertionError("overflowed buffer must NOT be applied")
 
     import threading
