@@ -87,6 +87,15 @@ class MasterServer:
         self.garbage_scan_seconds = garbage_scan_seconds
         self.guard = security.Guard(secret)
         self.metrics = Metrics(namespace="master")
+        #: Exclusive admin lease for the shell (reference: the master's
+        #: LeaseAdminToken behind shell `lock`/`unlock`): one named
+        #: client at a time may run destructive choreography; the lease
+        #: expires unless renewed so a crashed shell never wedges the
+        #: cluster.
+        self.admin_lease_seconds = 30.0
+        self._admin_mu = threading.Lock()
+        self._admin_holder = ""
+        self._admin_expires = 0.0
         #: Prometheus push-gateway address, distributed to volume
         #: servers via heartbeat responses (the reference's
         #: -metrics.address flow).
@@ -337,6 +346,37 @@ class MasterServer:
         return pb.volume_stub(ch)
 
     # ------------- core ops -------------
+
+    # ---- admin lock (shell lock/unlock) ----
+
+    def admin_acquire(self, client: str) -> dict:
+        """Acquire (or renew) the exclusive shell lease. Raises
+        PermissionError naming the holder when another live lease
+        exists."""
+        if not client:
+            raise ValueError("admin lock needs a client name")
+        with self._admin_mu:
+            now = time.time()
+            if (self._admin_holder
+                    and self._admin_holder != client
+                    and self._admin_expires > now):
+                raise PermissionError(
+                    f"cluster is locked by {self._admin_holder}")
+            self._admin_holder = client
+            self._admin_expires = now + self.admin_lease_seconds
+            return {"holder": client,
+                    "leaseSeconds": self.admin_lease_seconds}
+
+    def admin_release(self, client: str) -> dict:
+        with self._admin_mu:
+            if self._admin_holder and self._admin_holder != client \
+                    and self._admin_expires > time.time():
+                raise PermissionError(
+                    f"cluster is locked by {self._admin_holder}, "
+                    f"not {client}")
+            self._admin_holder = ""
+            self._admin_expires = 0.0
+            return {"released": True}
 
     def grow_volume(self, collection: str = "",
                     replication: Optional[str] = None,
@@ -640,6 +680,19 @@ def _make_http_handler(ms: MasterServer):
                     else:
                         self._json(ms.ha.handle_heartbeat(req))
                 except (ValueError, OSError) as e:
+                    self._json({"error": str(e)}, 400)
+            elif u.path in ("/admin/lock", "/admin/unlock"):
+                if self._proxy_to_leader():
+                    return
+                try:
+                    client = q.get("client", "")
+                    if u.path == "/admin/lock":
+                        self._json(ms.admin_acquire(client))
+                    else:
+                        self._json(ms.admin_release(client))
+                except PermissionError as e:
+                    self._json({"error": str(e)}, 409)
+                except ValueError as e:
                     self._json({"error": str(e)}, 400)
             elif u.path == "/vol/grow":
                 if self._proxy_to_leader():

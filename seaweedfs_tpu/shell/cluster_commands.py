@@ -48,6 +48,12 @@ class ClusterEnv:
     out: io.TextIOBase = None  # type: ignore[assignment]
     _channels: dict = field(default_factory=dict)
     _filer_client: object = None
+    #: True while this shell holds the master's exclusive admin lease.
+    locked: bool = False
+    _lock_client: str = ""
+    _lease_lost: bool = False
+    _renew_stop: object = None
+    _renew_thread: object = None
 
     def __post_init__(self):
         if self.out is None:
@@ -65,6 +71,11 @@ class ClusterEnv:
         return self._filer_client
 
     def close(self) -> None:
+        if self.locked:
+            try:
+                self.admin_unlock()
+            except ShellError:
+                pass
         for ch in self._channels.values():
             ch.close()
         self._channels.clear()
@@ -129,8 +140,141 @@ class ClusterEnv:
             return [l.url for l in e.locations]
         return []
 
+    # -- exclusive admin lease (shell lock/unlock) --
+
+    def _admin_call(self, verb: str) -> dict:
+        import json as json_mod
+        import urllib.error
+        import urllib.request
+
+        url = (f"http://{self.master_url}/admin/{verb}"
+               f"?client={self._lock_client}")
+        req = urllib.request.Request(url, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json_mod.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json_mod.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise ShellError(msg) from None
+        except urllib.error.URLError as e:
+            # connection-level failure must surface as the same error
+            # type or close()/finally cleanup paths leak past it
+            raise ShellError(
+                f"master {self.master_url} unreachable: {e}") from None
+
+    def _start_renewer(self, lease: float) -> None:
+        """Renew at a third of the lease period; a failed renew
+        immediately retries an acquire (a merely-expired free lease is
+        recovered silently) and otherwise marks the lease LOST so the
+        next destructive command refuses instead of running unlocked."""
+        import threading
+
+        self._lease_lost = False
+        self._renew_stop = threading.Event()
+
+        def renew():
+            while not self._renew_stop.wait(max(0.5, lease / 3)):
+                try:
+                    self._admin_call("lock")
+                except ShellError:
+                    self._lease_lost = True
+                    return
+
+        self._renew_thread = threading.Thread(
+            target=renew, daemon=True, name="shell-admin-lease")
+        self._renew_thread.start()
+
+    def _stop_renewer(self) -> None:
+        if self._renew_stop is not None:
+            self._renew_stop.set()
+            self._renew_thread.join(timeout=2)
+            self._renew_stop = self._renew_thread = None
+
+    def admin_lock(self) -> None:
+        """Hold the master's exclusive lease until admin_unlock (the
+        REPL `lock` command), renewed in the background so a crashed
+        shell frees the cluster after one lease period."""
+        if self.locked:
+            return
+        if not self._lock_client:
+            self._lock_client = _lock_client_name()
+        lease = float(self._admin_call("lock").get("leaseSeconds", 30))
+        self.locked = True
+        self._start_renewer(lease)
+
+    def admin_unlock(self) -> None:
+        if not self.locked:
+            return
+        self._stop_renewer()
+        self.locked = False
+        self._admin_call("unlock")
+
+    def exclusive(self):
+        """Context for one destructive command. A held REPL lock passes
+        through (unless its lease was lost — then refuse loudly); a
+        one-shot acquires ephemerally WITH renewal, so commands longer
+        than one lease period keep their exclusivity."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if self.locked:
+                if self._lease_lost:
+                    self.locked = False
+                    raise ShellError(
+                        "admin lease was lost (expired or taken while "
+                        "this shell was stalled); run 'lock' again "
+                        "before destructive commands")
+                yield
+                return
+            if not self._lock_client:
+                self._lock_client = _lock_client_name()
+            lease = float(
+                self._admin_call("lock").get("leaseSeconds", 30))
+            self._start_renewer(lease)
+            try:
+                yield
+                if self._lease_lost:
+                    raise ShellError(
+                        "admin lease was lost mid-command; cluster "
+                        "state may have been mutated concurrently — "
+                        "re-check before retrying")
+            finally:
+                self._stop_renewer()
+                try:
+                    self._admin_call("unlock")
+                except ShellError:
+                    pass
+        return cm()
+
+
+def _lock_client_name() -> str:
+    """Distinct per shell instance: two shells in one process (or one
+    host) must contend, not alias each other's lease."""
+    import os
+    import socket
+    import uuid
+
+    return f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
+
 
 CLUSTER_COMMANDS: dict[str, Callable[[ClusterEnv, list[str]], None]] = {}
+
+#: Commands that mutate cluster state and therefore run under the
+#: master's exclusive admin lease (the reference shell requires `lock`
+#: before these; here a one-shot invocation auto-acquires the lease
+#: around the single command, while a REPL `lock` holds it across
+#: commands — same mutual exclusion, kinder one-shot UX).
+DESTRUCTIVE_COMMANDS = {
+    "ec.encode", "ec.decode", "ec.rebuild", "ec.balance",
+    "volume.move", "volume.balance", "volume.fix.replication",
+    "volume.vacuum", "volume.deleteEmpty", "volume.mark",
+    "volumeServer.evacuate", "collection.delete", "volume.grow",
+    "volume.tier.upload", "volume.tier.download", "volume.check.disk",
+}
 
 
 def cluster_command(name: str):
@@ -1072,6 +1216,28 @@ def cmd_cluster_status(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"{len(nodes)} data nodes")
 
 
+@cluster_command("lock")
+def cmd_lock(env: ClusterEnv, argv: list[str]) -> None:
+    """Hold the master's exclusive admin lease across commands
+    (command_lock.go); renewed automatically until `unlock`."""
+    p = _parser("lock")
+    p.parse_args(argv)
+    env.admin_lock()
+    env.println("locked (exclusive admin lease held; renews "
+                "automatically until 'unlock')")
+
+
+@cluster_command("unlock")
+def cmd_unlock(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("unlock")
+    p.parse_args(argv)
+    if not env.locked:
+        env.println("not locked")
+        return
+    env.admin_unlock()
+    env.println("unlocked")
+
+
 def run_cluster_command(env: ClusterEnv, line: str) -> None:
     parts = shlex.split(line)
     if not parts:
@@ -1085,7 +1251,14 @@ def run_cluster_command(env: ClusterEnv, line: str) -> None:
     if fn is None:
         raise ShellError(f"unknown command {name!r} (try 'help')")
     try:
-        fn(env, argv)
+        if name in DESTRUCTIVE_COMMANDS:
+            # mutating choreography runs under the master's exclusive
+            # admin lease: held REPL locks pass through, one-shots
+            # acquire/release around this single command
+            with env.exclusive():
+                fn(env, argv)
+        else:
+            fn(env, argv)
     except ShellError:
         raise
     except (argparse.ArgumentError, SystemExit) as e:

@@ -425,3 +425,73 @@ def test_shell_volume_check_disk(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_shell_admin_lock(cluster):
+    master, servers = cluster
+    env1, out1 = _env(master)
+    env2, out2 = _env(master)
+    try:
+        run_cluster_command(env1, "lock")
+        assert "locked" in out1.getvalue()
+        # another shell cannot lock or run destructive commands
+        with pytest.raises(Exception, match="locked by"):
+            run_cluster_command(env2, "lock")
+        with pytest.raises(Exception, match="locked by"):
+            run_cluster_command(env2, "volume.balance")
+        # read-only commands stay available to everyone
+        run_cluster_command(env2, "volume.list")
+        # the holder itself can run destructive commands
+        run_cluster_command(env1, "volume.balance")
+        run_cluster_command(env1, "unlock")
+        assert "unlocked" in out1.getvalue()
+        # now the second shell's one-shot auto-acquire works
+        run_cluster_command(env2, "volume.balance")
+    finally:
+        env1.close()
+        env2.close()
+
+
+def test_shell_admin_lock_lease_expires(cluster):
+    master, _ = cluster
+    master.admin_lease_seconds = 0.3
+    env1, _ = _env(master)
+    env2, _ = _env(master)
+    try:
+        # ephemeral acquire that "crashes" before release: take the
+        # lease directly and never renew
+        env1._lock_client = "crashed-shell"
+        env1._admin_call("lock")
+        with pytest.raises(Exception, match="locked by"):
+            run_cluster_command(env2, "volume.balance")
+        time.sleep(0.4)  # lease expires with no renewal
+        run_cluster_command(env2, "volume.balance")
+    finally:
+        master.admin_lease_seconds = 30.0
+        env1.close()
+        env2.close()
+
+
+def test_shell_admin_lock_loss_refuses_destructive(cluster):
+    """A REPL shell whose lease was taken while it stalled must refuse
+    destructive commands instead of running unlocked."""
+    master, _ = cluster
+    master.admin_lease_seconds = 0.3
+    env1, _ = _env(master)
+    env2, _ = _env(master)
+    try:
+        run_cluster_command(env1, "lock")
+        # simulate a stalled shell: stop renewing, let the lease lapse,
+        # and let another shell claim it
+        env1._stop_renewer()
+        env1._lease_lost = True
+        time.sleep(0.4)
+        run_cluster_command(env2, "lock")
+        with pytest.raises(Exception, match="lease was lost"):
+            run_cluster_command(env1, "volume.balance")
+        assert not env1.locked  # the stale hold is dropped
+        run_cluster_command(env2, "unlock")
+    finally:
+        master.admin_lease_seconds = 30.0
+        env1.close()
+        env2.close()
