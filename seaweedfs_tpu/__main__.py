@@ -117,6 +117,11 @@ def _run_fix(argv: list[str]) -> int:
     return run_fix(argv)
 
 
+def _run_backup(argv: list[str]) -> int:
+    from .volume_tools import run_backup
+    return run_backup(argv)
+
+
 def _run_server(argv: list[str]) -> int:
     from .server_cmd import main
     return main(argv)
@@ -157,6 +162,7 @@ COMMANDS = {
     "filer.replicate": _run_filer_replicate,
     "filer.sync": _run_filer_sync,
     "fix": _run_fix,
+    "backup": _run_backup,
     "export": _run_export,
     "server": _run_server,
     "watch": _run_watch,

@@ -110,6 +110,17 @@ class FilerClient:
             new_directory=new_dir, new_name=new_name,
             signatures=list(signatures)))
 
+    def subscribe(self, path_prefix: str = "/", since_ns: int = 0,
+                  client_name: str = "client"):
+        """Raw SubscribeMetadata stream (blocking generator). The
+        first yielded item is the filer's hello marker (entry-less);
+        callers wanting change notifications can treat every item as
+        'something happened under the prefix'."""
+        yield from self._stub().SubscribeMetadata(
+            filer_pb2.SubscribeMetadataRequest(
+                client_name=client_name, path_prefix=path_prefix,
+                since_ns=since_ns))
+
     def configuration(self) -> filer_pb2.GetFilerConfigurationResponse:
         """The filer's stable signature (+ default collection/
         replication) — filer.sync's loop-prevention token."""
@@ -147,8 +158,9 @@ class FilerClient:
             with urllib.request.urlopen(req, timeout=120) as r:
                 return r.read()
         except urllib.error.HTTPError as e:
-            raise FilerClientError(
-                f"GET {path}: {e.code}") from e
+            err = FilerClientError(f"GET {path}: {e.code}")
+            err.code = e.code  # lets callers tell 404 from transient
+            raise err from e
 
     def copy_data(self, src_path: str, dst_path: str, size: int,
                   mime: str = "", window: int = 32 * 1024 * 1024,

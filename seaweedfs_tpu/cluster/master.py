@@ -352,7 +352,13 @@ class MasterServer:
     def admin_acquire(self, client: str) -> dict:
         """Acquire (or renew) the exclusive shell lease. Raises
         PermissionError naming the holder when another live lease
-        exists."""
+        exists.
+
+        Like the reference's master lease, this lives in the LEADER's
+        memory: an HA failover forgets it, so a lock can briefly be
+        granted twice across a leader change (the displaced holder's
+        renewer detects the conflict within a third of the lease and
+        its shell then refuses further destructive commands)."""
         if not client:
             raise ValueError("admin lock needs a client name")
         with self._admin_mu:

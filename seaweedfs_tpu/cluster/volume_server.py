@@ -593,8 +593,11 @@ class _VolumeServicer:
                 return
             raise StoreError(f"{path} does not exist")
         stop = request.stop_offset or path.stat().st_size
+        start = min(request.start_offset, stop)
         with open(path, "rb") as f:
-            sent = 0
+            if start:
+                f.seek(start)
+            sent = start
             while sent < stop:
                 chunk = f.read(min(_COPY_CHUNK, stop - sent))
                 if not chunk:

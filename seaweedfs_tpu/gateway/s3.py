@@ -32,6 +32,10 @@ from ..util.stats import Metrics
 from .s3_auth import AuthError, Identity, SigV4Verifier
 
 BUCKETS_DIR = "/buckets"
+#: Filer-stored gateway config (the reference keeps its s3 identities
+#: in the filer and the gateway subscribes for live reloads; shell
+#: `s3.configure` edits this file).
+S3_CONF_PATH = "/etc/s3/identities.json"
 UPLOADS_DIR = ".uploads"
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
@@ -79,12 +83,92 @@ class S3Gateway:
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
+        #: identities passed explicitly (-config file) are static; with
+        #: none, the gateway follows the filer-stored config and
+        #: reloads it live (the reference's s3.configure flow)
+        self.static_identities = identities is not None
         self.auth = SigV4Verifier(identities)
         self.metrics = Metrics(namespace="s3")
         self._http_server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._conf_stop = threading.Event()
+        self._conf_thread: Optional[threading.Thread] = None
+        #: becomes True after the first DEFINITIVE config read (loaded
+        #: or confirmed absent); before that, transient filer errors
+        #: leave the gateway deny-all instead of open
+        self._conf_loaded = False
+
+    def _load_filer_identities(self) -> None:
+        try:
+            raw = self.filer.get_data(S3_CONF_PATH)
+        except Exception as e:  # noqa: BLE001
+            if getattr(e, "code", None) == 404:
+                # confirmed absent: the operator removed the config,
+                # gateway runs open (reference default without config)
+                self.auth.set_identities(None)
+                self._conf_loaded = True
+            elif self._conf_loaded:
+                # transient (filer restart, network): auth must NOT
+                # fail open — keep the previous identity set
+                glog.warning("s3: cannot read %s (%s); keeping "
+                             "previous identities", S3_CONF_PATH, e)
+            else:
+                # never read a definitive state: deny everything
+                # rather than starting open with a config possibly
+                # present but unreadable
+                glog.warning("s3: cannot read %s (%s); denying all "
+                             "requests until the filer answers",
+                             S3_CONF_PATH, e)
+                self.auth.set_unavailable()
+            return
+        try:
+            import json as json_mod
+            idents = parse_identities(json_mod.loads(raw))
+        except Exception as e:  # noqa: BLE001 — keep the old set
+            glog.warning("s3: bad %s: %s (keeping previous identities)",
+                         S3_CONF_PATH, e)
+            return
+        self.auth.set_identities(idents)
+        self._conf_loaded = True
+        glog.info("s3: loaded %d identities from filer %s",
+                  len(idents), S3_CONF_PATH)
+
+    def _follow_conf(self) -> None:
+        """Reload identities whenever the filer-stored config changes
+        (SubscribeMetadata on its directory; reconnect with backoff)."""
+        import grpc  # noqa: F401
+
+        conf_dir = S3_CONF_PATH.rsplit("/", 1)[0]
+        while not self._conf_stop.is_set():
+            try:
+                attached = False
+                for resp in self.filer.subscribe(
+                        path_prefix=conf_dir,
+                        client_name=f"s3-{self.port}"):
+                    if self._conf_stop.is_set():
+                        return
+                    if not attached:
+                        # the stream's hello marker: re-read the config
+                        # once per (re)attach, covering changes made
+                        # while we were detached (live-only streams
+                        # replay nothing)
+                        attached = True
+                        self._load_filer_identities()
+                        continue
+                    note = resp.event_notification
+                    if note.new_entry.name or note.old_entry.name:
+                        self._load_filer_identities()
+            except Exception:  # noqa: BLE001 — filer restart etc.
+                if self._conf_stop.wait(1.0):
+                    return
 
     def start(self) -> "S3Gateway":
+        if not self.static_identities:
+            self._load_filer_identities()
+            self._conf_thread = threading.Thread(
+                target=self._follow_conf, daemon=True,
+                name=f"s3-conf-{self.port}")
+            self._conf_thread.start()
         handler = _make_handler(self)
         self._http_server = ThreadingHTTPServer((self.ip, self.port),
                                                 handler)
@@ -97,6 +181,7 @@ class S3Gateway:
         return self
 
     def stop(self) -> None:
+        self._conf_stop.set()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()
@@ -605,14 +690,10 @@ def _make_handler(gw: S3Gateway):
     return Handler
 
 
-def load_identities(path: str) -> list[Identity]:
-    """s3-config JSON: {"identities": [{"name", "credentials":
-    [{"accessKey", "secretKey"}], "actions": [...]}]} — the reference's
-    s3.json shape."""
-    import json
-
-    with open(path) as f:
-        cfg = json.load(f)
+def parse_identities(cfg: dict) -> list[Identity]:
+    """{"identities": [{"name", "credentials": [{"accessKey",
+    "secretKey"}], "actions": [...]}]} — the reference's s3.json
+    shape, shared by the -config file and the filer-stored config."""
     out = []
     for ident in cfg.get("identities", []):
         for cred in ident.get("credentials", []):
@@ -622,6 +703,13 @@ def load_identities(path: str) -> list[Identity]:
                 secret_key=cred["secretKey"],
                 actions=tuple(ident.get("actions", ["Admin"]))))
     return out
+
+
+def load_identities(path: str) -> list[Identity]:
+    import json
+
+    with open(path) as f:
+        return parse_identities(json.load(f))
 
 
 def main(argv: list[str]) -> int:

@@ -96,8 +96,23 @@ def _check_date_freshness(amz_date: str, cred_date: str) -> None:
 
 class SigV4Verifier:
     def __init__(self, identities: Optional[list[Identity]] = None):
+        #: Deny-all gate for "config exists but is unreadable" — auth
+        #: must fail CLOSED until a definitive load (or confirmed
+        #: absence) happens, never open because the filer was down.
+        self.deny_all = False
+        self.set_identities(identities)
+
+    def set_identities(self,
+                       identities: Optional[list[Identity]]) -> None:
+        """Atomically swap the identity set (live reload from the
+        filer-stored config; a dict rebind is atomic under the GIL so
+        in-flight verifies see either the old or the new set)."""
         self.by_access_key = {i.access_key: i
                               for i in (identities or [])}
+        self.deny_all = False
+
+    def set_unavailable(self) -> None:
+        self.deny_all = True
 
     @property
     def open_access(self) -> bool:
@@ -107,6 +122,9 @@ class SigV4Verifier:
                headers, body_sha256: str) -> Optional[Identity]:
         """Returns the authenticated Identity (None if gateway is open).
         Raises AuthError on bad/missing credentials."""
+        if self.deny_all:
+            raise AuthError("AccessDenied",
+                            "identity configuration unavailable")
         if self.open_access:
             return None
         auth = headers.get("Authorization", "")

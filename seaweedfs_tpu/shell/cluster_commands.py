@@ -172,16 +172,28 @@ class ClusterEnv:
         next destructive command refuses instead of running unlocked."""
         import threading
 
+        import time as time_mod
+
         self._lease_lost = False
         self._renew_stop = threading.Event()
 
         def renew():
-            while not self._renew_stop.wait(max(0.5, lease / 3)):
+            expires = time_mod.monotonic() + lease
+            wait = max(0.5, lease / 3)
+            while not self._renew_stop.wait(wait):
                 try:
                     self._admin_call("lock")
-                except ShellError:
-                    self._lease_lost = True
-                    return
+                    expires = time_mod.monotonic() + lease
+                    wait = max(0.5, lease / 3)
+                except ShellError as e:
+                    # a CONFLICT means the lease is genuinely gone; a
+                    # transient master hiccup is retried (faster) for
+                    # as long as the server-side lease can still be
+                    # live — only past expiry is it truly lost
+                    if "locked by" in str(e) or                             time_mod.monotonic() >= expires:
+                        self._lease_lost = True
+                        return
+                    wait = max(0.5, lease / 6)
 
         self._renew_thread = threading.Thread(
             target=renew, daemon=True, name="shell-admin-lease")
@@ -229,6 +241,12 @@ class ClusterEnv:
                         "this shell was stalled); run 'lock' again "
                         "before destructive commands")
                 yield
+                if self._lease_lost:
+                    self.locked = False
+                    raise ShellError(
+                        "admin lease was lost mid-command; cluster "
+                        "state may have been mutated concurrently — "
+                        "re-check before retrying (then 'lock' again)")
                 return
             if not self._lock_client:
                 self._lock_client = _lock_client_name()
@@ -274,6 +292,7 @@ DESTRUCTIVE_COMMANDS = {
     "volume.vacuum", "volume.deleteEmpty", "volume.mark",
     "volumeServer.evacuate", "collection.delete", "volume.grow",
     "volume.tier.upload", "volume.tier.download", "volume.check.disk",
+    "s3.configure",
 }
 
 

@@ -231,6 +231,63 @@ def cmd_s3_bucket_delete(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"deleted bucket {args.name}")
 
 
+@cluster_command("s3.configure")
+def cmd_s3_configure(env: ClusterEnv, argv: list[str]) -> None:
+    """Manage the filer-stored S3 identity config the gateway reloads
+    live (command_s3_configure.go): upsert or delete an identity, show
+    the resulting JSON, and persist it with -apply."""
+    from ..gateway.s3 import S3_CONF_PATH
+
+    p = _parser("s3.configure")
+    p.add_argument("-user", default="",
+                   help="identity name to add/update/delete")
+    p.add_argument("-access_key", default="")
+    p.add_argument("-secret_key", default="")
+    p.add_argument("-actions", default="",
+                   help="comma-separated: Admin, Read, Write, "
+                        "optionally bucket-scoped like Write:bucket")
+    p.add_argument("-delete", action="store_true")
+    p.add_argument("-apply", action="store_true",
+                   help="persist (default: dry-run print)")
+    args = p.parse_args(argv)
+    fc = _fc(env)
+    try:
+        cfg = json.loads(fc.get_data(S3_CONF_PATH))
+    except Exception as e:  # noqa: BLE001
+        if getattr(e, "code", None) == 404:
+            cfg = {"identities": []}  # confirmed: no config yet
+        else:
+            # a transient read error + -apply would otherwise persist
+            # an EMPTY config and lock every existing user out
+            raise ShellError(
+                f"s3.configure: cannot read current config "
+                f"({e}); retry when the filer answers") from None
+    idents = cfg.setdefault("identities", [])
+    if args.user:
+        idents[:] = [i for i in idents if i.get("name") != args.user]
+        if not args.delete:
+            if not args.access_key or not args.secret_key:
+                raise ShellError(
+                    "s3.configure: -access_key and -secret_key are "
+                    "required to add/update an identity")
+            idents.append({
+                "name": args.user,
+                "credentials": [{"accessKey": args.access_key,
+                                 "secretKey": args.secret_key}],
+                "actions": [a for a in args.actions.split(",") if a]
+                or ["Admin"],
+            })
+    elif args.delete:
+        raise ShellError("s3.configure: -delete needs -user")
+    env.println(json.dumps(cfg, indent=2))
+    if args.apply:
+        fc.put_data(S3_CONF_PATH, json.dumps(cfg, indent=2).encode(),
+                    mime="application/json")
+        env.println(f"applied to {S3_CONF_PATH} (gateways reload live)")
+    else:
+        env.println("dry run (use -apply to persist)")
+
+
 def _entry_to_json(directory: str, e) -> dict:
     return {
         "dir": directory,

@@ -125,3 +125,70 @@ def test_watch_streams_mutations_and_skips_hello(tmp_path):
             proc.terminate()
             proc.wait(timeout=10)
         fs.stop()
+
+
+def test_backup_incremental_and_after_vacuum(cluster, tmp_path, capsys):
+    """weed backup: full pull, then an incremental that moves only the
+    appended tail, then a forced full re-copy after compaction bumps
+    the superblock revision; the local replica always reads back every
+    live needle."""
+    import numpy as np
+
+    from seaweedfs_tpu import volume_tools
+    from seaweedfs_tpu.cluster import operation
+    from seaweedfs_tpu.cluster.wdclient import MasterClient
+    from seaweedfs_tpu.storage.store import volume_base_name
+    from seaweedfs_tpu.storage.volume import Volume
+
+    master, vs = cluster
+    mc = MasterClient(master.url)
+    try:
+        rng = np.random.default_rng(17)
+        blobs = [rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+                 for _ in range(4)]
+        fids = operation.submit(mc, blobs)
+        vid = int(fids[0].split(",")[0])
+        keep = [(f, b) for f, b in zip(fids, blobs)
+                if int(f.split(",")[0]) == vid]
+        bdir = tmp_path / "bk"
+
+        r1 = volume_tools.backup_volume(master.url, vid, bdir)
+        assert r1["full"] and r1["bytes"] > 0
+
+        def check_replica():
+            v = Volume(bdir / volume_base_name(vid)).load()
+            try:
+                for fid, want in keep:
+                    key = int(fid.split(",")[1][:-8], 16)
+                    assert v.read_needle(key).data == want
+            finally:
+                v.close()
+
+        check_replica()
+
+        # append more: the second run is incremental and small
+        blobs2 = [rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()]
+        f2 = operation.submit(mc, blobs2)
+        if int(f2[0].split(",")[0]) == vid:
+            keep.append((f2[0], blobs2[0]))
+        r2 = volume_tools.backup_volume(master.url, vid, bdir)
+        assert not r2["full"]
+        assert r2["bytes"] < r1["bytes"]
+        check_replica()
+
+        # delete one needle and vacuum: revision bumps -> full re-copy
+        victim_fid = keep.pop(0)[0]
+        operation.delete(mc, victim_fid)
+        vs.store.vacuum_volume(vid, threshold=0.0)
+        r3 = volume_tools.backup_volume(master.url, vid, bdir)
+        assert r3["full"]
+        check_replica()
+
+        # CLI surface
+        assert volume_tools.run_backup(
+            ["-server", master.url, "-volumeId", str(vid),
+             "-dir", str(bdir)]) == 0
+        out = capsys.readouterr().out
+        assert "incremental" in out or "full" in out
+    finally:
+        mc.close()

@@ -388,3 +388,93 @@ def test_identity_action_authorization(tmp_path_factory):
         filer.stop()
         vs.stop()
         master.stop()
+
+
+def test_filer_config_identities_live_reload(tmp_path_factory):
+    """Gateway with no static identities follows the filer-stored
+    config: s3.configure -apply takes effect WITHOUT a restart."""
+    import io
+
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+    from seaweedfs_tpu.gateway.s3 import S3_CONF_PATH
+    from seaweedfs_tpu.shell import fs_commands  # noqa: F401
+    from seaweedfs_tpu.shell.cluster_commands import (
+        ClusterEnv, run_cluster_command)
+
+    master = MasterServer(port=_free_port_pair(), volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=21).start()
+    store = Store([tmp_path_factory.mktemp("fcvol")], max_volumes=4)
+    vs = VolumeServer(store, port=_free_port_pair(),
+                      master_url=master.url, pulse_seconds=PULSE).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.nodes:
+        time.sleep(0.05)
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    fc = FilerClient(filer.url)
+    # seed a config BEFORE the gateway starts
+    fc.put_data(S3_CONF_PATH, json.dumps({"identities": [
+        {"name": "boot", "credentials": [
+            {"accessKey": "BOOTAK", "secretKey": "BOOTSK"}],
+         "actions": ["Admin"]}]}).encode())
+    gw = S3Gateway(filer.url, port=_free_port_pair()).start()
+
+    def signed_put(path, ak, sk):
+        url = f"http://{gw.url}{path}"
+        hdrs = sign_request_headers("PUT", url, {}, b"", ak, sk)
+        req = urllib.request.Request(url, method="PUT", headers=hdrs)
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        # config loaded at start: unsigned refused, seeded key works
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://{gw.url}/fcbkt", method="PUT"), timeout=30)
+        assert ei.value.code == 403
+        assert signed_put("/fcbkt", "BOOTAK", "BOOTSK").status == 200
+
+        # live update through the shell: add a user, drop the old one
+        env = ClusterEnv(master_url=master.url, filer_url=filer.url,
+                         out=io.StringIO())
+        run_cluster_command(
+            env, "s3.configure -user live -access_key LIVEAK "
+                 "-secret_key LIVESK -actions Admin -apply")
+        run_cluster_command(
+            env, "s3.configure -user boot -delete -apply")
+        env.close()
+
+        deadline = time.time() + 15
+        ok = False
+        while time.time() < deadline:
+            try:
+                if signed_put("/fcbkt2", "LIVEAK", "LIVESK").status \
+                        == 200:
+                    ok = True
+                    break
+            except urllib.error.HTTPError:
+                time.sleep(0.1)
+        assert ok, "gateway never picked up the new identity"
+        # the deleted identity is refused now
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            signed_put("/fcbkt3", "BOOTAK", "BOOTSK")
+        assert ei.value.code == 403
+    finally:
+        gw.stop()
+        fc.close()
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_verifier_fails_closed_when_config_unavailable():
+    """A gateway that cannot read a possibly-present identity config
+    must deny, not fall open; a later definitive load re-opens."""
+    from seaweedfs_tpu.gateway.s3_auth import AuthError, SigV4Verifier
+
+    v = SigV4Verifier(None)
+    assert v.verify("GET", "/", "", {}, "") is None  # open by default
+    v.set_unavailable()
+    with pytest.raises(AuthError, match="unavailable"):
+        v.verify("GET", "/", "", {}, "")
+    v.set_identities(None)  # confirmed no-config -> open again
+    assert v.verify("GET", "/", "", {}, "") is None
