@@ -136,8 +136,6 @@ class S3Gateway:
     def _follow_conf(self) -> None:
         """Reload identities whenever the filer-stored config changes
         (SubscribeMetadata on its directory; reconnect with backoff)."""
-        import grpc  # noqa: F401
-
         conf_dir = S3_CONF_PATH.rsplit("/", 1)[0]
         while not self._conf_stop.is_set():
             try:
@@ -159,8 +157,12 @@ class S3Gateway:
                     if note.new_entry.name or note.old_entry.name:
                         self._load_filer_identities()
             except Exception:  # noqa: BLE001 — filer restart etc.
-                if self._conf_stop.wait(1.0):
-                    return
+                pass
+            # stream ended (error OR clean server-side return): pause
+            # before re-attaching so a lagging/shutting-down filer is
+            # not hammered in a tight loop
+            if self._conf_stop.wait(1.0):
+                return
 
     def start(self) -> "S3Gateway":
         if not self.static_identities:

@@ -292,7 +292,7 @@ DESTRUCTIVE_COMMANDS = {
     "volume.vacuum", "volume.deleteEmpty", "volume.mark",
     "volumeServer.evacuate", "collection.delete", "volume.grow",
     "volume.tier.upload", "volume.tier.download", "volume.check.disk",
-    "s3.configure",
+    "s3.configure", "volume.fsck",
 }
 
 
@@ -1166,6 +1166,189 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"volume.check.disk: {checked} replicated volumes "
                 f"checked, {divergent} divergent replicas, "
                 f"{synced} needles synced, {skews} unresolved skews")
+
+
+@cluster_command("volume.fsck")
+def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
+    """Cross-check filer chunk references against volume needle maps
+    (command_volume_fsck.go): needles no file references are ORPHANS
+    (reclaimable with -purge); referenced chunks absent from their
+    volume are MISSING (broken files — always just reported). Writes
+    racing the scan can look orphaned/missing for one pass; re-run (or
+    hold `lock`) before trusting a purge."""
+    from ..pb import volume_server_pb2 as vpb
+    from ..storage import idx as idx_mod
+    from ..storage import needle as needle_mod
+    from ..storage.types import TOMBSTONE_FILE_SIZE, FileId
+    from ..util import security
+
+    p = _parser("volume.fsck")
+    p.add_argument("-collection", default="",
+                   help="limit to one collection")
+    p.add_argument("-purge", action="store_true",
+                   help="delete orphan needles from normal volumes")
+    p.add_argument("-cutoffSeconds", type=int, default=300,
+                   help="never purge needles appended within this "
+                        "window (writes racing the scan look orphaned "
+                        "for one pass; reference fsck's cutoff)")
+    p.add_argument("-v", action="store_true", dest="verbose")
+    args = p.parse_args(argv)
+    from . import fs_commands  # deferred: avoids import cycle
+
+    fc = fs_commands._fc(env)
+
+    # 1) referenced chunk fids from the filer tree
+    referenced: dict[tuple[str, int], set[int]] = {}
+    where: dict[tuple[str, int, int], str] = {}  # -> first path
+    for d, e in fs_commands._walk(fc, "/"):
+        if e.is_directory:
+            continue
+        col = e.attributes.collection
+        if args.collection and col != args.collection:
+            continue
+        for c in e.chunks:
+            try:
+                f = FileId.parse(c.file_id)
+            except ValueError:
+                continue
+            referenced.setdefault((col, f.volume_id),
+                                  set()).add(f.key)
+            where.setdefault((col, f.volume_id, f.key),
+                             f"{d.rstrip('/')}/{e.name}")
+
+    # 2) live needle maps volume by volume (normal: .idx replay; EC:
+    #    .ecx with .ecj deletes)
+    resp = env.volume_list()
+    vol_holder: dict[tuple[str, int], str] = {}
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for v in dn.volume_infos:
+                    vol_holder.setdefault((v.collection, v.id), dn.id)
+    ec_holder: dict[tuple[str, int], str] = {}
+    for n in env.collect_ec_nodes():
+        for vid in n.shards:
+            ec_holder.setdefault((n.collections.get(vid, ""), vid),
+                                 n.url)
+
+    def fetch(url: str, vid: int, col: str, ext: str,
+              optional: bool = False) -> bytes:
+        return b"".join(r.file_content for r in env.volume(url).CopyFile(
+            vpb.CopyFileRequest(
+                volume_id=vid, collection=col, ext=ext,
+                ignore_source_file_not_found=optional)))
+
+    live: dict[tuple[str, int], dict[int, int]] = {}
+    is_ec: set[tuple[str, int]] = set()
+    for key_, url in vol_holder.items():
+        col, vid = key_
+        if args.collection and col != args.collection:
+            continue
+        m: dict[int, int] = {}
+        for e in idx_mod.walk_index_blob(fetch(url, vid, col, ".idx")):
+            if e.size == TOMBSTONE_FILE_SIZE:
+                m.pop(e.key, None)
+            else:
+                m[e.key] = e.size
+        live[key_] = m
+    for key_, url in ec_holder.items():
+        col, vid = key_
+        if key_ in live:
+            continue
+        if args.collection and col != args.collection:
+            continue
+        m = {}
+        for e in idx_mod.walk_index_blob(fetch(url, vid, col, ".ecx")):
+            if e.size != TOMBSTONE_FILE_SIZE:
+                m[e.key] = e.size
+        ecj = fetch(url, vid, col, ".ecj", optional=True)
+        for i in range(0, len(ecj) - len(ecj) % 8, 8):
+            m.pop(int.from_bytes(ecj[i:i + 8], "big"), None)
+        live[key_] = m
+        is_ec.add(key_)
+
+    # 3) compare
+    orphans = orphan_bytes = missing = purged = 0
+    guard = security.Guard(env.secret)
+    for key_, m in sorted(live.items()):
+        col, vid = key_
+        refs = referenced.get(key_, set())
+        extra = [k for k in m if k not in refs]
+        gone = sorted(refs - set(m))
+        if extra:
+            orphans += len(extra)
+            vol_bytes = sum(m[k] for k in extra)
+            orphan_bytes += vol_bytes
+            env.println(
+                f"volume {vid}{f' ({col})' if col else ''}"
+                f"{' [ec]' if key_ in is_ec else ''}: "
+                f"{len(extra)} orphan needle(s), {vol_bytes} bytes"
+                + (" — purging" if args.purge and key_ not in is_ec
+                   else ""))
+            if args.verbose:
+                for k in sorted(extra):
+                    env.println(f"  orphan needle {k}")
+            if args.purge and key_ not in is_ec:
+                import time as time_mod
+                import urllib.request
+                url = vol_holder[key_]
+                now_ns = time_mod.time_ns()
+                for k in sorted(extra):
+                    blob = env.volume(url).ReadNeedleBlob(
+                        vpb.ReadNeedleBlobRequest(
+                            volume_id=vid, collection=col,
+                            needle_id=k))
+                    try:
+                        rec = needle_mod.Needle.parse(blob.needle_blob)
+                    except needle_mod.NeedleError:
+                        # v1 record (no timestamp): age unknowable,
+                        # cutoff can't apply
+                        rec = needle_mod.Needle.parse(
+                            blob.needle_blob, version=1)
+                    if rec.append_at_ns and \
+                            now_ns - rec.append_at_ns < \
+                            args.cutoffSeconds * 1_000_000_000:
+                        env.println(
+                            f"  needle {k} appended "
+                            f"{(now_ns - rec.append_at_ns) / 1e9:.0f}s "
+                            f"ago (< cutoff); NOT purged — likely a "
+                            f"write racing the scan")
+                        continue
+                    cookie = rec.cookie
+                    fid = str(FileId(volume_id=vid, key=k,
+                                     cookie=cookie))
+                    req = urllib.request.Request(
+                        f"http://{url}/{fid}"
+                        + (f"?collection={col}" if col else ""),
+                        method="DELETE")
+                    if guard.enabled:
+                        req.add_header("Authorization",
+                                       f"BEARER {guard.sign(fid)}")
+                    with urllib.request.urlopen(req, timeout=60):
+                        pass
+                    purged += 1
+        for k in gone:
+            missing += 1
+            env.println(
+                f"volume {vid}{f' ({col})' if col else ''}: needle "
+                f"{k} MISSING but referenced by "
+                f"{where.get((col, vid, k), '?')}")
+    # volumes the filer references but no live server holds at all: a
+    # down node or deleted volume — every chunk on it is unreadable
+    for key_ in sorted(set(referenced) - set(live)):
+        col, vid = key_
+        missing += len(referenced[key_])
+        env.println(
+            f"volume {vid}{f' ({col})' if col else ''}: NOT FOUND on "
+            f"any server but {len(referenced[key_])} chunk(s) "
+            f"reference it (e.g. "
+            f"{where.get((col, vid, next(iter(referenced[key_]))), '?')})")
+    env.println(
+        f"volume.fsck: {len(live)} volumes, {orphans} orphan "
+        f"needles ({orphan_bytes} bytes)"
+        + (f", {purged} purged" if args.purge else "")
+        + f", {missing} missing chunks"
+        + (" — some files are BROKEN" if missing else ""))
 
 
 @cluster_command("cluster.check")

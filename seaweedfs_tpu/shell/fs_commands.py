@@ -247,21 +247,36 @@ def cmd_s3_configure(env: ClusterEnv, argv: list[str]) -> None:
                    help="comma-separated: Admin, Read, Write, "
                         "optionally bucket-scoped like Write:bucket")
     p.add_argument("-delete", action="store_true")
+    p.add_argument("-reset", action="store_true",
+                   help="start from an empty config (repairs a "
+                        "corrupt identities.json)")
     p.add_argument("-apply", action="store_true",
                    help="persist (default: dry-run print)")
     args = p.parse_args(argv)
     fc = _fc(env)
-    try:
-        cfg = json.loads(fc.get_data(S3_CONF_PATH))
-    except Exception as e:  # noqa: BLE001
-        if getattr(e, "code", None) == 404:
-            cfg = {"identities": []}  # confirmed: no config yet
+    if args.reset:
+        cfg = {"identities": []}
+    else:
+        try:
+            raw = fc.get_data(S3_CONF_PATH)
+        except Exception as e:  # noqa: BLE001
+            if getattr(e, "code", None) == 404:
+                raw = None  # confirmed: no config yet
+            else:
+                # a transient read error + -apply would otherwise
+                # persist an EMPTY config and lock every user out
+                raise ShellError(
+                    f"s3.configure: cannot read current config "
+                    f"({e}); retry when the filer answers") from None
+        if raw is None:
+            cfg = {"identities": []}
         else:
-            # a transient read error + -apply would otherwise persist
-            # an EMPTY config and lock every existing user out
-            raise ShellError(
-                f"s3.configure: cannot read current config "
-                f"({e}); retry when the filer answers") from None
+            try:
+                cfg = json.loads(raw)
+            except ValueError as e:
+                raise ShellError(
+                    f"s3.configure: {S3_CONF_PATH} holds invalid "
+                    f"JSON ({e}); rebuild it with -reset") from None
     idents = cfg.setdefault("identities", [])
     if args.user:
         idents[:] = [i for i in idents if i.get("name") != args.user]

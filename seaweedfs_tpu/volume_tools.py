@@ -279,27 +279,41 @@ def backup_volume(master_url: str, volume_id: int, directory: str | Path,
                 f.truncate()
             return n
 
-        dat, idx = dat_path(base), idx_path(base)
-        local_dat = dat.stat().st_size if dat.exists() else 0
-        full = True
-        if local_dat >= SUPER_BLOCK_SIZE and \
-                local_dat <= st.dat_size:
-            # same compact revision = increments are valid
-            remote_sb = b"".join(r.file_content for r in stub.CopyFile(
+        def remote_superblock() -> bytes:
+            return b"".join(r.file_content for r in stub.CopyFile(
                 volume_server_pb2.CopyFileRequest(
                     volume_id=volume_id, collection=collection,
                     ext=".dat", stop_offset=SUPER_BLOCK_SIZE)))
+
+        dat, idx = dat_path(base), idx_path(base)
+        local_dat = dat.stat().st_size if dat.exists() else 0
+        sb_before = remote_superblock()
+        full = True
+        if local_dat >= SUPER_BLOCK_SIZE and \
+                local_dat <= st.dat_size:
+            # same superblock (compact revision) = increments are valid
             with open(dat, "rb") as f:
-                local_sb = f.read(SUPER_BLOCK_SIZE)
-            full = remote_sb != local_sb
+                full = f.read(SUPER_BLOCK_SIZE) != sb_before
+
+        def pull_pair(dat_start: int, idx_start: int) -> int:
+            # .idx BEFORE .dat (the VolumeCopy ordering invariant): a
+            # write racing the pulls then only leaves unindexed tail
+            # bytes in the replica's .dat — never an index entry
+            # pointing past its end
+            n = pull(".idx", idx, idx_start)
+            return n + pull(".dat", dat, dat_start)
+
         moved = 0
         if full:
-            moved += pull(".dat", dat, 0)
-            moved += pull(".idx", idx, 0)
+            moved += pull_pair(0, 0)
         else:
-            moved += pull(".dat", dat, local_dat)
             local_idx = idx.stat().st_size if idx.exists() else 0
-            moved += pull(".idx", idx, local_idx)
+            moved += pull_pair(local_dat, local_idx)
+        if remote_superblock() != sb_before:
+            # a compaction landed MID-backup: the idx/dat pair mixes
+            # revisions — redo as a full copy against the new state
+            moved += pull_pair(0, 0)
+            full = True
         return {"bytes": moved, "full": full}
     finally:
         channel.close()
