@@ -545,6 +545,20 @@ class _VolumeServicer:
             resp.ec_shard_ids.extend(sorted(m.shard_ids))
         return resp
 
+    def VolumeConfigure(self, request, context):
+        """Rewrite the superblock replica placement; the next
+        heartbeat reports the new setting and the master re-files the
+        volume under the matching layout."""
+        resp = volume_server_pb2.VolumeConfigureResponse()
+        try:
+            self.vs.store.configure_replication(
+                request.volume_id, request.replication,
+                request.collection)
+            self.vs.heartbeat_now()
+        except Exception as e:  # noqa: BLE001 — reported, not raised
+            resp.error = str(e)
+        return resp
+
     def ReadNeedleBlob(self, request, context):
         """Raw record bytes for one live needle (the replica-sync read
         behind volume.check.disk; reference volume_grpc_read_write.go

@@ -59,6 +59,8 @@ VOLUME_METHODS = [
            volume_server_pb2.VolumeMarkWritableResponse),
     Method("VolumeStatus", volume_server_pb2.VolumeStatusRequest,
            volume_server_pb2.VolumeStatusResponse),
+    Method("VolumeConfigure", volume_server_pb2.VolumeConfigureRequest,
+           volume_server_pb2.VolumeConfigureResponse),
     Method("CopyFile", volume_server_pb2.CopyFileRequest,
            volume_server_pb2.CopyFileResponse, SERVER_STREAM),
     Method("ReadNeedleBlob", volume_server_pb2.ReadNeedleBlobRequest,

@@ -292,7 +292,7 @@ DESTRUCTIVE_COMMANDS = {
     "volume.vacuum", "volume.deleteEmpty", "volume.mark",
     "volumeServer.evacuate", "collection.delete", "volume.grow",
     "volume.tier.upload", "volume.tier.download", "volume.check.disk",
-    "s3.configure", "volume.fsck",
+    "s3.configure", "volume.fsck", "volume.configure.replication",
 }
 
 
@@ -1168,6 +1168,53 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
                 f"{synced} needles synced, {skews} unresolved skews")
 
 
+@cluster_command("volume.configure.replication")
+def cmd_volume_configure_replication(env: ClusterEnv,
+                                     argv: list[str]) -> None:
+    """Change a volume's replica placement on every replica
+    (command_volume_configure_replication.go). Only the superblock
+    setting changes; run volume.fix.replication afterwards to create
+    the replicas the new placement asks for."""
+    p = _parser("volume.configure.replication")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", required=True)
+    args = p.parse_args(argv)
+    locs = env.volume_locations(args.volumeId)
+    if not locs:
+        raise ShellError(f"volume {args.volumeId} not found")
+    # Try EVERY replica even after a failure: stopping midway would
+    # leave the survivors' superblocks silently divergent with no
+    # record of which were already changed.
+    done: list[str] = []
+    failed: list[tuple[str, str]] = []
+    for url in locs:
+        try:
+            resp = env.volume(url).VolumeConfigure(
+                volume_server_pb2.VolumeConfigureRequest(
+                    volume_id=args.volumeId,
+                    collection=args.collection,
+                    replication=args.replication))
+            err = resp.error
+        except Exception as e:  # noqa: BLE001 — keep going
+            err = str(e)
+        if err:
+            failed.append((url, err))
+        else:
+            done.append(url)
+    if failed:
+        detail = "; ".join(f"{u}: {e}" for u, e in failed)
+        raise ShellError(
+            f"volume.configure.replication: volume {args.volumeId} "
+            f"now {args.replication} on {done or 'NO replicas'} but "
+            f"FAILED on {detail} — replica placements are divergent; "
+            f"re-run when those servers answer")
+    env.println(
+        f"volume.configure.replication: volume {args.volumeId} -> "
+        f"{args.replication} on {', '.join(done)} "
+        f"(run volume.fix.replication to materialize new replicas)")
+
+
 @cluster_command("volume.fsck")
 def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
     """Cross-check filer chunk references against volume needle maps
@@ -1294,10 +1341,16 @@ def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
                 url = vol_holder[key_]
                 now_ns = time_mod.time_ns()
                 for k in sorted(extra):
-                    blob = env.volume(url).ReadNeedleBlob(
-                        vpb.ReadNeedleBlobRequest(
-                            volume_id=vid, collection=col,
-                            needle_id=k))
+                    try:
+                        blob = env.volume(url).ReadNeedleBlob(
+                            vpb.ReadNeedleBlobRequest(
+                                volume_id=vid, collection=col,
+                                needle_id=k))
+                    except Exception as e:  # noqa: BLE001
+                        env.println(
+                            f"  purge of needle {k} skipped "
+                            f"(read failed: {e})")
+                        continue
                     try:
                         rec = needle_mod.Needle.parse(blob.needle_blob)
                     except needle_mod.NeedleError:
@@ -1321,12 +1374,19 @@ def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
                         f"http://{url}/{fid}"
                         + (f"?collection={col}" if col else ""),
                         method="DELETE")
-                    if guard.enabled:
-                        req.add_header("Authorization",
-                                       f"BEARER {guard.sign(fid)}")
-                    with urllib.request.urlopen(req, timeout=60):
-                        pass
-                    purged += 1
+                    try:
+                        if guard.enabled:
+                            req.add_header(
+                                "Authorization",
+                                f"BEARER {guard.sign(fid)}")
+                        with urllib.request.urlopen(req, timeout=60):
+                            pass
+                        purged += 1
+                    except Exception as e:  # noqa: BLE001
+                        # one vanished/failed needle (vacuum racing
+                        # the purge) must not abort the sweep
+                        env.println(
+                            f"  purge of needle {k} failed: {e}")
         for k in gone:
             missing += 1
             env.println(

@@ -295,6 +295,12 @@ class Store:
 
     # -- data plane -------------------------------------------------------
 
+    def configure_replication(self, volume_id: int,
+                              replication: str,
+                              collection: str = "") -> None:
+        self.get_volume(volume_id, collection).configure_replication(
+            replication)
+
     def write_needle(self, volume_id: int, n: Needle,
                      collection: str = "") -> int:
         if self.is_readonly(volume_id, collection):

@@ -408,6 +408,23 @@ class Volume:
             self._idx.flush()
         return True
 
+    def configure_replication(self, replication: str) -> None:
+        """Rewrite the superblock's replica-placement byte in place
+        (reference: volume_grpc_admin.go VolumeConfigure — the setting
+        lives only in the superblock, so no data moves)."""
+        from .superblock import ReplicaPlacement
+        rp = ReplicaPlacement.parse(replication)
+        with self._lock:
+            if self._dat is None:
+                raise VolumeError("volume not open")
+            if self.readonly:
+                raise VolumeError(
+                    f"volume {self.volume_id} is read-only (tiered); "
+                    f"download it first")
+            self.super_block.replica_placement = rp
+            self._dat.write_at(self.super_block.to_bytes(), 0)
+            self._dat.flush()
+
     def sync(self) -> None:
         with self._lock:
             if self._dat is not None:

@@ -309,11 +309,21 @@ def backup_volume(master_url: str, volume_id: int, directory: str | Path,
         else:
             local_idx = idx.stat().st_size if idx.exists() else 0
             moved += pull_pair(local_dat, local_idx)
-        if remote_superblock() != sb_before:
-            # a compaction landed MID-backup: the idx/dat pair mixes
-            # revisions — redo as a full copy against the new state
+        # A compaction landing MID-backup mixes revisions in the pulled
+        # idx/dat pair; redo full copies until one completes with the
+        # superblock unchanged across it (bounded: a vacuum per pull
+        # forever would mean the cluster is melting anyway).
+        for _attempt in range(5):
+            sb_after = remote_superblock()
+            if sb_after == sb_before:
+                break
+            sb_before = sb_after
             moved += pull_pair(0, 0)
             full = True
+        else:
+            raise RuntimeError(
+                f"volume {volume_id} compacted on every copy attempt; "
+                f"backup inconsistent — retry later")
         return {"bytes": moved, "full": full}
     finally:
         channel.close()

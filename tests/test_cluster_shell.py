@@ -495,3 +495,41 @@ def test_shell_admin_lock_loss_refuses_destructive(cluster):
         master.admin_lease_seconds = 30.0
         env1.close()
         env2.close()
+
+
+def test_shell_volume_configure_replication(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        fids = operation.submit(mc, [b"reconf-me"])
+        vid = int(fids[0].split(",")[0])
+        _settle(servers)
+        holder = next(vs for vs in servers if vs.store.has_volume(vid))
+        assert str(holder.store.get_volume(vid)
+                   .super_block.replica_placement) == "000"
+
+        env, out = _env(master)
+        run_cluster_command(
+            env, f"volume.configure.replication -volumeId {vid} "
+                 f"-replication 010")
+        assert "-> 010" in out.getvalue()
+        # superblock changed in place...
+        assert str(holder.store.get_volume(vid)
+                   .super_block.replica_placement) == "010"
+        _settle(servers)
+        # ...heartbeats report it, so fix.replication creates the copy
+        run_cluster_command(env, "volume.fix.replication")
+        _settle(servers)
+        assert sum(vs.store.has_volume(vid) for vs in servers) == 2
+        assert operation.download(mc, fids[0]) == b"reconf-me"
+        # survives a reload from disk
+        v = holder.store.get_volume(vid)
+        v.close()
+        from seaweedfs_tpu.storage.volume import Volume
+        v2 = Volume(v.base).load()
+        assert str(v2.super_block.replica_placement) == "010"
+        v2.close()
+        holder.store.volumes.pop(("", vid), None)
+        env.close()
+    finally:
+        mc.close()
