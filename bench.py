@@ -600,6 +600,13 @@ def child_core() -> None:
         # helper, and a hang mid-child would cost every later stage in
         # this process; probe3 (separate, bounded process) explores it.
         candidates = [("transpose", gf_apply, 4, "u8"),
+                      # production-dispatch smoke runs EARLY (right
+                      # after the first headline banks): windows can
+                      # die mid-race (2026-07-31 05:16 did), and the
+                      # grouped executable is the round's key unproven
+                      # number — its reference falls back to the BANKED
+                      # race when this run's race hasn't happened yet
+                      ("dispatch", None, 0, ""),
                       ("gate", None, 0, ""),
                       ("transpW", _transpW, 4, "w5"),
                       ("swarW64", _swarW64, 4, "w4"),
@@ -618,6 +625,121 @@ def child_core() -> None:
                       ("swarW64", _swarW64, 16, "w4"),
                       ("transpW", _transpW, 32, "w5")]
 
+    def _race_reference():
+        """Best raced transpW number: this run's if present, else the
+        banked window's (honest fallback — the dispatch smoke runs
+        before the race so a dying window still yields a judgeable
+        frac; the post-race refresh tightens it)."""
+        vals = [v for kk, v in res.items()
+                if kk.startswith("headline_transpW_")
+                and kk.endswith("_gibps")
+                and isinstance(v, (int, float))]
+        try:
+            with open(os.path.join(ARTIFACTS, "TPU_SUCCESS2")) as bf:
+                banked = json.loads(bf.read())
+            vals += [v for kk, v in banked.get("extras", {}).items()
+                     if kk.startswith("headline_transpW_")
+                     and kk.endswith("_gibps")
+                     and isinstance(v, (int, float))]
+        except Exception:  # noqa: BLE001 — no banked result yet
+            pass
+        return max(vals, default=None)
+
+    def _dispatch_smoke():
+        """VERDICT r4 item 2: the bytes users get from
+        Encoder.encode_parity_host (host u8 slab -> zero-copy word view
+        -> upload -> words kernel -> _HostParity re-view) must match
+        the oracle-smoked kernel, and its cached executable (plus the
+        grouped apply_matrix_host_multi one) must run at race speed —
+        proving the auto dispatch ships the raced number, not a
+        glue-laden cousin."""
+        if not (on_acc and not interp and "w5" in slab_forms):
+            return
+        try:
+            from seaweedfs_tpu.ops import rs_jax as rs_jax_mod
+            old_policy = rs_jax_mod.HOST_DISPATCH
+            rs_jax_mod.HOST_DISPATCH = "device"  # smoke the device leg
+            try:
+                hp = enc.encode_parity_host(host_slabs[0])
+                if not isinstance(hp, rs_jax_mod._HostParity):
+                    raise AssertionError(
+                        "production dispatch did not take the word-form "
+                        "device path")
+                got = np.asarray(hp)
+                want = np.asarray(encode_fn(dev_slabs[0]))
+                if not np.array_equal(got, want):
+                    raise AssertionError(
+                        "production-path parity != oracle-smoked kernel")
+            finally:
+                rs_jax_mod.HOST_DISPATCH = old_policy
+            # time the exact executable the production dispatch cached
+            fnp = rs_jax_mod._jitted_apply(
+                coefs.tobytes(), m, k, "pallas_words")
+            w5 = slab_forms["w5"]
+            for d in w5:
+                fnp(d)  # warm
+            y = None
+            t0 = time.perf_counter()
+            for _ in range(passes):
+                for d in w5:
+                    y = fnp(d)
+            # single device stream: fetching the LAST output's bytes
+            # means every queued kernel before it has run (slice ON
+            # DEVICE first — np.asarray(y) whole would drag 160 MiB
+            # through the tunnel and poison the timing)
+            np.asarray(y[..., :1])
+            t_d = time.perf_counter() - t0
+            d_gibps = passes * len(w5) * per_call / GIB / t_d
+            res["dispatch_device_gibps"] = round(d_gibps, 3)
+            race_ref = _race_reference()
+            if race_ref:
+                res["dispatch_vs_race_frac"] = round(d_gibps / race_ref, 3)
+            res["dispatch_path_ok"] = True
+            log(f"production dispatch (encode_parity_host words path): "
+                f"bytes OK, executable {d_gibps:.2f} GiB/s"
+                + (f" ({100 * res['dispatch_vs_race_frac']:.0f}% of "
+                   f"raced transpW)" if race_ref else ""))
+            _persist(res)
+            # grouped production dispatch (apply_matrix_host_multi's
+            # executable): n slab args per call, the production analog
+            # of the raced transpW_n16 candidate. Reuses each uploaded
+            # slab twice per call exactly like the race did.
+            ng = min(16, 2 * len(w5))
+            fnm = rs_jax_mod._jitted_apply_multi(
+                coefs.tobytes(), m, k, "pallas_words", ng)
+            grp = tuple(w5[i % len(w5)] for i in range(ng))
+            ys = fnm(*grp)  # warm (compile)
+            # bytes check: grouped outputs == the single-dispatch
+            # executable's outputs for the same slabs (slice on device;
+            # fetching whole parities would drag MiBs through the link)
+            for j in (0, ng - 1):
+                want_j = fnp(grp[j])
+                if not np.array_equal(np.asarray(ys[j][..., :1]),
+                                      np.asarray(want_j[..., :1])):
+                    raise AssertionError(
+                        f"grouped dispatch output {j} != single path")
+            t0 = time.perf_counter()
+            y = None
+            for _ in range(passes):
+                y = fnm(*grp)
+            np.asarray(y[-1][..., :1])
+            t_m = time.perf_counter() - t0
+            m_gibps = passes * ng * per_call / GIB / t_m
+            res["dispatch_multi_gibps"] = round(m_gibps, 3)
+            res["dispatch_multi_nargs"] = ng
+            if race_ref:
+                res["dispatch_multi_vs_race_frac"] = round(
+                    m_gibps / race_ref, 3)
+            log(f"grouped production dispatch (n={ng}): "
+                f"{m_gibps:.2f} GiB/s"
+                + (f" ({100 * res['dispatch_multi_vs_race_frac']:.0f}% "
+                   f"of raced transpW)" if race_ref else ""))
+        except Exception as e:  # noqa: BLE001 — smoke must not kill core
+            res["dispatch_path_ok"] = False
+            res["dispatch_path_error"] = f"{type(e).__name__}: {e}"[:200]
+            log(f"production-dispatch smoke failed: {e}")
+        _persist(res)
+
     compute_gibps = 0.0
     best_name = None
     best_cand = None  # (gf, form, fold) of the winner, set at win time
@@ -630,6 +752,10 @@ def child_core() -> None:
     # extra compiles of the hang-prone variants.
     ref_ck: dict[int, bytes] = {}
     for name, gf, nargs, form in candidates:
+        if name == "dispatch":
+            _dispatch_smoke()
+            _persist(res)
+            continue
         if name == "gate":
             swar_ok = _gate_swar()
             _persist(res)
@@ -725,99 +851,19 @@ def child_core() -> None:
             f"{100 * res['roofline_frac']:.2f}% of physics")
         _persist(res)
 
-    # -- production-dispatch smoke (VERDICT r4 item 2): the bytes users
-    # get from Encoder.encode_parity_host (host u8 slab -> zero-copy
-    # word view -> upload -> words kernel -> _HostParity re-view) must
-    # match the oracle-smoked kernel, and its cached executable must
-    # run at race speed — proving the auto dispatch ships the raced
-    # number, not a glue-laden cousin.
-    if on_acc and not interp and "w5" in slab_forms:
-        try:
-            from seaweedfs_tpu.ops import rs_jax as rs_jax_mod
-            old_policy = rs_jax_mod.HOST_DISPATCH
-            rs_jax_mod.HOST_DISPATCH = "device"  # smoke the device leg
-            try:
-                hp = enc.encode_parity_host(host_slabs[0])
-                if not isinstance(hp, rs_jax_mod._HostParity):
-                    raise AssertionError(
-                        "production dispatch did not take the word-form "
-                        "device path")
-                got = np.asarray(hp)
-                want = np.asarray(encode_fn(dev_slabs[0]))
-                if not np.array_equal(got, want):
-                    raise AssertionError(
-                        "production-path parity != oracle-smoked kernel")
-            finally:
-                rs_jax_mod.HOST_DISPATCH = old_policy
-            # time the exact executable the production dispatch cached
-            fnp = rs_jax_mod._jitted_apply(
-                coefs.tobytes(), m, k, "pallas_words")
-            w5 = slab_forms["w5"]
-            for d in w5:
-                fnp(d)  # warm
-            y = None
-            t0 = time.perf_counter()
-            for _ in range(passes):
-                for d in w5:
-                    y = fnp(d)
-            # single device stream: fetching the LAST output's bytes
-            # means every queued kernel before it has run (slice ON
-            # DEVICE first — np.asarray(y) whole would drag 160 MiB
-            # through the tunnel and poison the timing)
-            np.asarray(y[..., :1])
-            t_d = time.perf_counter() - t0
-            d_gibps = passes * len(w5) * per_call / GIB / t_d
-            res["dispatch_device_gibps"] = round(d_gibps, 3)
-            race_ref = max(
-                (v for kk, v in res.items() if kk.startswith(
-                    "headline_transpW_") and kk.endswith("_gibps")
-                    and isinstance(v, (int, float))), default=None)
-            if race_ref:
-                res["dispatch_vs_race_frac"] = round(d_gibps / race_ref, 3)
-            res["dispatch_path_ok"] = True
-            log(f"production dispatch (encode_parity_host words path): "
-                f"bytes OK, executable {d_gibps:.2f} GiB/s"
-                + (f" ({100 * res['dispatch_vs_race_frac']:.0f}% of "
-                   f"raced transpW)" if race_ref else ""))
-            # grouped production dispatch (apply_matrix_host_multi's
-            # executable): n slab args per call, the production analog
-            # of the raced transpW_n16 candidate. Reuses each uploaded
-            # slab twice per call exactly like the race did.
-            ng = min(16, 2 * len(w5))
-            fnm = rs_jax_mod._jitted_apply_multi(
-                coefs.tobytes(), m, k, "pallas_words", ng)
-            grp = tuple(w5[i % len(w5)] for i in range(ng))
-            ys = fnm(*grp)  # warm (compile)
-            # bytes check: grouped outputs == the single-dispatch
-            # executable's outputs for the same slabs (slice on device;
-            # fetching whole parities would drag MiBs through the link)
-            for j in (0, ng - 1):
-                want_j = fnp(grp[j])
-                if not np.array_equal(np.asarray(ys[j][..., :1]),
-                                      np.asarray(want_j[..., :1])):
-                    raise AssertionError(
-                        f"grouped dispatch output {j} != single path")
-            t0 = time.perf_counter()
-            y = None
-            for _ in range(passes):
-                y = fnm(*grp)
-            np.asarray(y[-1][..., :1])
-            t_m = time.perf_counter() - t0
-            m_gibps = passes * ng * per_call / GIB / t_m
-            res["dispatch_multi_gibps"] = round(m_gibps, 3)
-            res["dispatch_multi_nargs"] = ng
-            if race_ref:
-                res["dispatch_multi_vs_race_frac"] = round(
-                    m_gibps / race_ref, 3)
-            log(f"grouped production dispatch (n={ng}): "
-                f"{m_gibps:.2f} GiB/s"
-                + (f" ({100 * res['dispatch_multi_vs_race_frac']:.0f}% "
-                   f"of raced transpW)" if race_ref else ""))
-        except Exception as e:  # noqa: BLE001 — smoke must not kill core
-            res["dispatch_path_ok"] = False
-            res["dispatch_path_error"] = f"{type(e).__name__}: {e}"[:200]
-            log(f"production-dispatch smoke failed: {e}")
-        _persist(res)
+    # -- production-dispatch frac refresh: the smoke ran EARLY (as a
+    # race pseudo-candidate) with the banked race as its reference;
+    # now that this run's race is in, recompute the fracs against the
+    # strictest reference available (max of in-run and banked).
+    rr = _race_reference()
+    for key, frac_key in (("dispatch_device_gibps",
+                           "dispatch_vs_race_frac"),
+                          ("dispatch_multi_gibps",
+                           "dispatch_multi_vs_race_frac")):
+        v = res.get(key)
+        if v and rr:
+            res[frac_key] = round(v / rr, 3)
+    _persist(res)
 
     # optional profiler trace of one pass of the plain encode (never fatal)
     try:

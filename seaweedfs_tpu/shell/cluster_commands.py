@@ -1206,7 +1206,8 @@ def cmd_volume_configure_replication(env: ClusterEnv,
         detail = "; ".join(f"{u}: {e}" for u, e in failed)
         raise ShellError(
             f"volume.configure.replication: volume {args.volumeId} "
-            f"now {args.replication} on {done or 'NO replicas'} but "
+            f"now {args.replication} on "
+            f"{', '.join(done) if done else 'NO replicas'} but "
             f"FAILED on {detail} — replica placements are divergent; "
             f"re-run when those servers answer")
     env.println(

@@ -311,20 +311,22 @@ def backup_volume(master_url: str, volume_id: int, directory: str | Path,
             moved += pull_pair(local_dat, local_idx)
         # A compaction landing MID-backup mixes revisions in the pulled
         # idx/dat pair; redo full copies until one completes with the
-        # superblock unchanged across it (bounded: a vacuum per pull
-        # forever would mean the cluster is melting anyway).
-        for _attempt in range(5):
+        # superblock unchanged across it — check-then-pull, so every
+        # copy performed is validated and the final iteration never
+        # wastes a full pull it cannot check (bounded: a vacuum per
+        # pull forever would mean the cluster is melting anyway).
+        for attempt in range(5):
             sb_after = remote_superblock()
             if sb_after == sb_before:
-                break
+                return {"bytes": moved, "full": full}
+            if attempt == 4:
+                break  # a pull we could not validate would be wasted
             sb_before = sb_after
             moved += pull_pair(0, 0)
             full = True
-        else:
-            raise RuntimeError(
-                f"volume {volume_id} compacted on every copy attempt; "
-                f"backup inconsistent — retry later")
-        return {"bytes": moved, "full": full}
+        raise RuntimeError(
+            f"volume {volume_id} compacted on every copy attempt; "
+            f"backup inconsistent — retry later")
     finally:
         channel.close()
 
