@@ -20,6 +20,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from .. import pb
 from ..filer import Filer, FilerError
+from ..filer import path_conf as path_conf_mod
 from ..filer.entry import Attr, Entry, FileChunk, normalize_path
 from ..filer.filechunks import total_size
 from ..filer.stores import MemoryStore, SqliteStore
@@ -44,9 +45,60 @@ class FilerServer:
         self.replication = replication
         self.master = MasterClient(master_url) if master_url else None
         self.metrics = Metrics(namespace="filer")
+        #: Per-path storage rules (filer.conf; shell fs.configure).
+        #: Loaded at start and re-read on changes via the filer's own
+        #: meta stream — empty when no conf exists.
+        self.path_conf = path_conf_mod.PathConf()
+        self._conf_stop = threading.Event()
         self._grpc_server = None
         self._http_server: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
+
+    def _load_path_conf(self) -> None:
+        if self.master is None:
+            return  # conf content lives in chunks; no master, no read
+        try:
+            raw = self.filer.read_file(
+                path_conf_mod.FILER_CONF_PATH, self.master)
+        except FilerError:
+            self.path_conf = path_conf_mod.PathConf()  # confirmed gone
+            return
+        except Exception as e:  # noqa: BLE001 — keep previous rules
+            glog.warning("filer: cannot read %s (%s); keeping %d "
+                         "path rules", path_conf_mod.FILER_CONF_PATH,
+                         e, len(self.path_conf))
+            return
+        try:
+            self.path_conf = path_conf_mod.PathConf.parse(raw)
+            glog.info("filer: %d path rule(s) from %s",
+                      len(self.path_conf),
+                      path_conf_mod.FILER_CONF_PATH)
+        except ValueError as e:
+            glog.warning("filer: bad %s: %s (keeping %d path rules)",
+                         path_conf_mod.FILER_CONF_PATH, e,
+                         len(self.path_conf))
+
+    def _follow_path_conf(self) -> None:
+        """In-process subscription to this filer's own meta stream,
+        reloading the rules whenever the conf directory changes."""
+        first = True
+        while not self._conf_stop.is_set():
+            try:
+                if not first:
+                    # changes delivered during the gap (overflow,
+                    # error) replay nowhere — re-read the conf on
+                    # every re-attach
+                    self._load_path_conf()
+                first = False
+                for ev in self.filer.subscribe(stop=self._conf_stop):
+                    if self._conf_stop.is_set():
+                        return
+                    if ev.directory.startswith(
+                            path_conf_mod.FILER_CONF_DIR):
+                        self._load_path_conf()
+            except Exception:  # noqa: BLE001 — overflow: resubscribe
+                if self._conf_stop.wait(0.5):
+                    return
 
     # ------------- lifecycle -------------
 
@@ -71,11 +123,18 @@ class FilerServer:
                              daemon=True, name=f"filer-http-{self.port}")
         t.start()
         self._threads.append(t)
+        self._load_path_conf()
+        t = threading.Thread(target=self._follow_path_conf,
+                             daemon=True,
+                             name=f"filer-conf-{self.port}")
+        t.start()
+        self._threads.append(t)
         glog.info("filer started at %s (grpc %d)", self.url,
                   _grpc_port(self.port))
         return self
 
     def stop(self) -> None:
+        self._conf_stop.set()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5).wait(timeout=2)
         if self._http_server:
@@ -365,11 +424,28 @@ def _make_http_handler(fs: FilerServer):
                     # normalize_path stripped the trailing slash; the raw
                     # URL says "store INTO this directory".
                     path = normalize_path(path + "/" + fname)
+            # per-path rules (filer.conf): explicit query params win,
+            # then the longest matching locationPrefix, then the
+            # server-wide flags
+            rule = fs.path_conf.match(path)
+            col = q.get("collection") or \
+                (rule.collection if rule else "") or fs.collection
+            rep = q.get("replication") or \
+                (rule.replication if rule else "") or fs.replication
+            ttl = q.get("ttl") or (rule.ttl if rule else "")
+            if ttl:
+                from ..storage.superblock import Ttl
+                try:
+                    Ttl.parse(ttl)
+                except ValueError:
+                    self._err(400, f"bad ttl {ttl!r}")
+                    return
             try:
                 entry = fs.filer.write_file(
                     path, body, fs.master,
-                    collection=q.get("collection", fs.collection),
-                    replication=q.get("replication", fs.replication),
+                    collection=col,
+                    replication=rep,
+                    ttl=ttl,
                     mime=ctype if not ctype.startswith(
                         "multipart/") else "",
                     chunk_size=int(q["maxMB"]) * 1024 * 1024
@@ -378,6 +454,12 @@ def _make_http_handler(fs: FilerServer):
                     signatures=_parse_signatures(q))
             except FilerError as e:
                 self._err(409, str(e))
+                return
+            except ValueError as e:
+                # bad replication/ttl reaching the assign path (e.g. a
+                # typo'd filer.conf rule) must be an HTTP error, not an
+                # aborted connection
+                self._err(400, str(e))
                 return
             self._send(201, json.dumps(
                 {"name": entry.name,

@@ -318,7 +318,8 @@ class Filer:
 
     def write_file(self, path: str, data: bytes, master,
                    collection: str = "", replication: str = "",
-                   mime: str = "", chunk_size: Optional[int] = None,
+                   ttl: str = "", mime: str = "",
+                   chunk_size: Optional[int] = None,
                    append: bool = False,
                    signatures: tuple = ()) -> Entry:
         """Split ``data`` into chunks, upload each (assign + POST), then
@@ -326,13 +327,23 @@ class Filer:
         from ..cluster import operation
 
         chunk_size = chunk_size or self.CHUNK_SIZE
+        if append:
+            cur0 = self.find_entry(normalize_path(path))
+            if cur0 is not None:
+                # appended chunks inherit the ENTRY's lifecycle: mixing
+                # the caller's/rule's ttl with an existing entry would
+                # put new chunks on volumes reaped at a different
+                # horizon than the entry advertises (silent data loss)
+                ttl = (f"{max(1, cur0.attr.ttl_sec // 60)}m"
+                       if cur0.attr.ttl_sec else "")
         # Upload outside any lock (slow), with 0-based offsets; the
         # append base is only decided at commit time, under the lock.
         now_ns = time.time_ns()
         new_chunks: list[FileChunk] = []
         for off in range(0, len(data), chunk_size):
             piece = data[off:off + chunk_size]
-            a = operation.assign(master, 1, collection, replication)
+            a = operation.assign(master, 1, collection, replication,
+                                 ttl=ttl)
             operation.upload(a.url, a.fid, bytes(piece), jwt=a.auth,
                              collection=collection)
             new_chunks.append(FileChunk(file_id=a.fid, offset=off,
@@ -354,8 +365,11 @@ class Filer:
                 attr = current.attr
             else:
                 chunks = new_chunks
+                from ..storage.superblock import Ttl
                 attr = Attr(collection=collection,
-                            replication=replication, mime=mime)
+                            replication=replication, mime=mime,
+                            ttl_sec=Ttl.parse(ttl).seconds if ttl
+                            else 0)
             attr.mtime = time.time()
             entry = Entry(path=path, attr=attr, chunks=chunks)
             self.create_entry(entry, signatures=signatures)

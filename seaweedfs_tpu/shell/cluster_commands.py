@@ -292,7 +292,8 @@ DESTRUCTIVE_COMMANDS = {
     "volume.vacuum", "volume.deleteEmpty", "volume.mark",
     "volumeServer.evacuate", "collection.delete", "volume.grow",
     "volume.tier.upload", "volume.tier.download", "volume.check.disk",
-    "s3.configure", "volume.fsck", "volume.configure.replication",
+    "s3.configure", "fs.configure", "volume.fsck",
+    "volume.configure.replication",
 }
 
 

@@ -231,6 +231,74 @@ def cmd_s3_bucket_delete(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"deleted bucket {args.name}")
 
 
+@cluster_command("fs.configure")
+def cmd_fs_configure(env: ClusterEnv, argv: list[str]) -> None:
+    """Manage per-path storage rules (command_fs_configure.go): writes
+    under -locationPrefix inherit the rule's collection/replication/
+    ttl; the filer reloads the stored filer.conf live."""
+    from ..filer.path_conf import FILER_CONF_PATH, PathConf, PathRule
+
+    p = _parser("fs.configure")
+    p.add_argument("-locationPrefix", default="",
+                   help="path prefix the rule applies to")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="",
+                   help="e.g. 5m, 2h, 1d (volume TTL class)")
+    p.add_argument("-delete", action="store_true")
+    p.add_argument("-apply", action="store_true",
+                   help="persist (default: dry-run print)")
+    args = p.parse_args(argv)
+    fc = _fc(env)
+    try:
+        raw = fc.get_data(FILER_CONF_PATH)
+    except Exception as e:  # noqa: BLE001
+        if getattr(e, "code", None) == 404:
+            raw = b'{"locations": []}'
+        else:
+            raise ShellError(
+                f"fs.configure: cannot read current conf ({e}); "
+                f"retry when the filer answers") from None
+    try:
+        conf = PathConf.parse(raw)
+    except ValueError as e:
+        raise ShellError(
+            f"fs.configure: {FILER_CONF_PATH} holds invalid JSON "
+            f"({e}); fix or remove it first") from None
+    rules = [r for r in conf.rules
+             if r.location_prefix != args.locationPrefix]
+    if args.locationPrefix and not args.delete:
+        # validate BEFORE persisting: a typo'd rule would poison every
+        # write under the prefix with opaque assign-time errors
+        from ..storage.superblock import ReplicaPlacement, Ttl
+        try:
+            if args.ttl:
+                Ttl.parse(args.ttl)
+            if args.replication:
+                ReplicaPlacement.parse(args.replication)
+        except ValueError as e:
+            raise ShellError(f"fs.configure: {e}") from None
+        rules.append(PathRule(
+            location_prefix=args.locationPrefix,
+            collection=args.collection,
+            replication=args.replication,
+            ttl=args.ttl))
+    elif args.delete and not args.locationPrefix:
+        raise ShellError("fs.configure: -delete needs -locationPrefix")
+    doc = {"locations": [r.to_json() for r in
+                         sorted(rules,
+                                key=lambda r: r.location_prefix)]}
+    env.println(json.dumps(doc, indent=2))
+    if args.apply:
+        fc.put_data(FILER_CONF_PATH,
+                    json.dumps(doc, indent=2).encode(),
+                    mime="application/json")
+        env.println(f"applied to {FILER_CONF_PATH} (filer reloads "
+                    f"live)")
+    else:
+        env.println("dry run (use -apply to persist)")
+
+
 @cluster_command("s3.configure")
 def cmd_s3_configure(env: ClusterEnv, argv: list[str]) -> None:
     """Manage the filer-stored S3 identity config the gateway reloads

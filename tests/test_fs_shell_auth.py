@@ -284,3 +284,93 @@ def test_volume_fsck(stack):
     finally:
         mc.close()
         fc.close()
+
+
+def test_fs_configure_path_rules(stack):
+    """filer.conf rules: writes under a prefix inherit collection/
+    replication/ttl (longest prefix wins, explicit params override),
+    live-reloaded through the filer's own meta stream."""
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+    from seaweedfs_tpu.storage.types import FileId
+
+    master, vs, filer = stack
+    fc = FilerClient(filer.url)
+    try:
+        _shell(stack,
+               "fs.configure -locationPrefix /hot/ -collection hot "
+               "-ttl 5m -apply")
+        _shell(stack,
+               "fs.configure -locationPrefix /hot/special/ "
+               "-collection special -apply")
+        out = _shell(stack, "fs.configure")
+        assert "/hot/" in out and "special" in out
+
+        deadline = time.time() + 10
+        while time.time() < deadline and len(filer.path_conf) < 2:
+            time.sleep(0.05)
+        assert len(filer.path_conf) == 2
+
+        fc.put_data("/hot/a.bin", b"h" * 100)
+        e = fc.lookup("/hot", "a.bin")
+        assert e.attributes.collection == "hot"
+        assert e.attributes.ttl_sec == 300
+        vid = FileId.parse(e.chunks[0].file_id).volume_id
+        assert vs.store.has_volume(vid, "hot")
+        assert str(vs.store.get_volume(vid, "hot")
+                   .super_block.ttl) == "5m"
+
+        # longest prefix wins
+        fc.put_data("/hot/special/b.bin", b"s" * 50)
+        e = fc.lookup("/hot/special", "b.bin")
+        assert e.attributes.collection == "special"
+
+        # explicit query param beats the rule
+        fc.put_data("/hot/c.bin", b"c" * 50,
+                    query="collection=explicit")
+        e = fc.lookup("/hot", "c.bin")
+        assert e.attributes.collection == "explicit"
+
+        # outside any prefix: server default (empty collection)
+        fc.put_data("/cold/d.bin", b"d" * 50)
+        e = fc.lookup("/cold", "d.bin")
+        assert e.attributes.collection == ""
+
+        # rule deletion reloads live too
+        _shell(stack,
+               "fs.configure -locationPrefix /hot/special/ -delete "
+               "-apply")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(filer.path_conf) != 1:
+            time.sleep(0.05)
+        assert len(filer.path_conf) == 1
+    finally:
+        fc.close()
+
+
+def test_fs_configure_rejects_bad_rules(stack):
+    import urllib.error
+    import urllib.request
+
+    _, _, filer = stack
+    err = None
+    try:
+        _shell(stack, "fs.configure -locationPrefix /x/ -ttl 5x -apply")
+    except ShellError as e:
+        err = str(e)
+    assert err and "5x" in err
+    err = None
+    try:
+        _shell(stack,
+               "fs.configure -locationPrefix /x/ -replication 9zz "
+               "-apply")
+    except ShellError as e:
+        err = str(e)
+    assert err
+    # a bad ttl on the HTTP write path is a clean 400, not a dropped
+    # connection
+    req = urllib.request.Request(
+        f"http://{filer.url}/ttltest.bin?ttl=abc",
+        data=b"x", method="PUT")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
