@@ -112,6 +112,11 @@ def _run_filer_sync(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_filer_meta_backup(argv: list[str]) -> int:
+    from .replication.meta_backup import main
+    return main(argv)
+
+
 def _run_fix(argv: list[str]) -> int:
     from .volume_tools import run_fix
     return run_fix(argv)
@@ -161,6 +166,7 @@ COMMANDS = {
     "mount": _run_mount,
     "filer.replicate": _run_filer_replicate,
     "filer.sync": _run_filer_sync,
+    "filer.meta.backup": _run_filer_meta_backup,
     "fix": _run_fix,
     "backup": _run_backup,
     "export": _run_export,

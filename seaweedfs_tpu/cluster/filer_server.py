@@ -45,6 +45,11 @@ class FilerServer:
         self.replication = replication
         self.master = MasterClient(master_url) if master_url else None
         self.metrics = Metrics(namespace="filer")
+        #: Process epoch (unix ns): exposed via GetFilerConfiguration
+        #: so resuming followers can detect that the in-memory
+        #: meta-log restarted and a gap-free resume is impossible.
+        import time as _time
+        self.started_ns = _time.time_ns()
         #: Per-path storage rules (filer.conf; shell fs.configure).
         #: Loaded at start and re-read on changes via the filer's own
         #: meta stream — empty when no conf exists.
@@ -263,7 +268,8 @@ class _FilerServicer:
         return filer_pb2.GetFilerConfigurationResponse(
             signature=self.fs.filer.signature,
             collection=self.fs.collection,
-            replication=self.fs.replication)
+            replication=self.fs.replication,
+            started_ns=self.fs.started_ns)
 
     def SubscribeMetadata(self, request, context):
         stop = threading.Event()

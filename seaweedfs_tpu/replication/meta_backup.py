@@ -1,0 +1,212 @@
+"""Continuous filer metadata backup into a local store.
+
+The ``weed filer.meta.backup`` analog (reference:
+weed/command/filer_meta_backup.go): follow a filer's metadata stream
+into a local sqlite store — a full tree walk first, then live events,
+with the resume point persisted in the store so a restarted backup
+continues where it left off (an expired meta-log window triggers the
+replicator's built-in full re-walk). ``--restore`` replays the store
+into a filer: metadata only, like ``fs.meta.load`` — chunk manifests
+are preserved, blob data must still exist on the volume servers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..cluster.filer_client import FilerClient
+from ..filer.entry import Attr, Entry, normalize_path, split_path
+from ..filer.stores import SqliteStore
+from ..util import glog
+from ..util import tls as tls_mod
+from .replicator import Replicator
+from .sinks import ReplicationSink
+
+#: kv key holding the source-clock resume point (per path prefix —
+#: a db reused with a different -path must re-walk the new subtree).
+def _ts_key(prefix: str) -> str:
+    return f"meta_backup.since_ns:{prefix}"
+
+
+#: kv key holding the source filer's process epoch: a mismatch means
+#: the in-memory meta-log restarted and a gap-free resume is
+#: impossible — re-walk instead of silently skipping the gap.
+def _epoch_key(prefix: str) -> str:
+    return f"meta_backup.source_epoch:{prefix}"
+
+
+class MetaBackupSink(ReplicationSink):
+    """Applies metadata events to a local :class:`SqliteStore`."""
+
+    def __init__(self, store: SqliteStore):
+        self.store = store
+
+    def apply(self, path: str, new_entry, old_entry=None,
+              signatures: tuple = ()) -> None:
+        from ..cluster.filer_server import pb_to_entry
+
+        path = normalize_path(path)
+        if new_entry is None:
+            try:
+                self.store.delete_entry(path)
+            except KeyError:
+                pass
+            return
+        d, _name = split_path(path)
+        entry = pb_to_entry(d, new_entry)
+        # parents must exist for listings of the backup to make sense
+        missing = []
+        parent = d
+        while parent != "/" and self.store.find_entry(parent) is None:
+            missing.append(parent)
+            parent, _ = split_path(parent)
+        for p in reversed(missing):
+            self.store.insert_entry(Entry(path=p,
+                                          attr=Attr(is_dir=True)))
+        if self.store.find_entry(path) is None:
+            self.store.insert_entry(entry)
+        else:
+            self.store.update_entry(entry)
+
+    def close(self) -> None:
+        self.store.close()
+
+
+class MetaBackup:
+    """A Replicator wired to a MetaBackupSink, with the resume point
+    persisted through the store's kv seam."""
+
+    def __init__(self, filer_url: str, db_path: str,
+                 path_prefix: str = "/"):
+        self.store = SqliteStore(db_path)
+        self.prefix = "/" + path_prefix.strip("/")
+        resume = self.store.kv_get(_ts_key(self.prefix))
+        since_ns = int(resume.decode()) if resume else 0
+        # a source restart wipes its in-memory meta-log: the persisted
+        # resume point cannot be gap-free, so force a full re-walk
+        saved_epoch = self.store.kv_get(_epoch_key(self.prefix))
+        self.source_epoch = self._source_epoch(filer_url)
+        if since_ns and (saved_epoch is None or
+                         saved_epoch.decode() !=
+                         str(self.source_epoch)):
+            glog.info("meta.backup: source filer restarted (epoch "
+                      "changed); re-walking the tree")
+            since_ns = 0
+        self.rep = Replicator(
+            filer_url, MetaBackupSink(self.store),
+            path_prefix=self.prefix, client_name="meta-backup",
+            bootstrap=since_ns == 0)
+        if since_ns:
+            self.rep.last_ts_ns = since_ns
+        self._stop = threading.Event()
+        self._persister: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _source_epoch(filer_url: str) -> int:
+        c = FilerClient(filer_url)
+        try:
+            return c.configuration().started_ns
+        except Exception:  # noqa: BLE001 — old source: epoch unknown
+            return 0
+        finally:
+            c.close()
+
+    def _persist_loop(self) -> None:
+        last = 0
+        while not self._stop.wait(1.0):
+            if not self.rep.bootstrap_done.is_set():
+                # a resume point saved mid-walk would permanently skip
+                # the unwalked rest of the tree on restart
+                continue
+            ts = self.rep.last_ts_ns
+            if ts != last:
+                self.store.kv_put(_ts_key(self.prefix),
+                                  str(ts).encode())
+                self.store.kv_put(_epoch_key(self.prefix),
+                                  str(self.source_epoch).encode())
+                last = ts
+
+    def start(self, wait_attach: float = 10.0) -> "MetaBackup":
+        self.rep.start(wait_attach=wait_attach)
+        self._persister = threading.Thread(
+            target=self._persist_loop, daemon=True,
+            name="meta-backup-ts")
+        self._persister.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._persister is not None:
+            self._persister.join(timeout=3)
+        if self.rep.bootstrap_done.is_set():
+            ts = self.rep.last_ts_ns
+            if ts:
+                self.store.kv_put(_ts_key(self.prefix),
+                                  str(ts).encode())
+                self.store.kv_put(_epoch_key(self.prefix),
+                                  str(self.source_epoch).encode())
+        self.rep.stop()  # closes the sink (and with it the store)
+
+    def wait_converged(self, pred, timeout: float = 45.0) -> bool:
+        return self.rep.wait_converged(pred, timeout=timeout)
+
+
+def restore(db_path: str, filer_url: str,
+            path_prefix: str = "/") -> int:
+    """Replay a backup store into a filer (metadata only); returns the
+    number of entries created."""
+    from ..cluster.filer_server import entry_to_pb
+
+    store = SqliteStore(db_path)
+    fc = FilerClient(filer_url)
+    n = 0
+    try:
+        stack = [normalize_path(path_prefix)]
+        while stack:
+            d = stack.pop()
+            for e in store.list_entries(d):
+                if e.is_dir:
+                    fc.mkdir(d, split_path(e.path)[1])
+                    stack.append(e.path)
+                else:
+                    fc.create(d, entry_to_pb(e))
+                n += 1
+    finally:
+        fc.close()
+        store.close()
+    return n
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """``python -m seaweedfs_tpu filer.meta.backup``."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="filer.meta.backup")
+    p.add_argument("-filer", required=True, help="filer host:port")
+    p.add_argument("-db", required=True,
+                   help="local sqlite backup file")
+    p.add_argument("-path", default="/", help="subtree to back up")
+    p.add_argument("-restore", action="store_true",
+                   help="replay the backup INTO the filer and exit")
+    p.add_argument("-config", default="",
+                   help="security.toml ([grpc.tls] client credentials)")
+    args = p.parse_args(argv)
+    from ..util import config as config_mod
+    tls_mod.install_from_config(
+        config_mod.load(args.config) if args.config else {})
+    if args.restore:
+        n = restore(args.db, args.filer, path_prefix=args.path)
+        print(f"filer.meta.backup: restored {n} entries to "
+              f"{args.filer}")
+        return 0
+    mb = MetaBackup(args.filer, args.db, path_prefix=args.path).start()
+    glog.info("filer.meta.backup: %s -> %s (prefix %s)", args.filer,
+              args.db, args.path)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mb.stop()
+    return 0

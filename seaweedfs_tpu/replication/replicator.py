@@ -139,6 +139,12 @@ class Replicator:
         #: Set once a subscribe stream is attached (hello received);
         #: events from that instant on are guaranteed delivered/replayed.
         self.attached = threading.Event()
+        #: Set once the backup/replication state is walk-complete: at
+        #: start for bootstrap=False followers, else when the first
+        #: bootstrap walk finishes. Consumers persisting a resume
+        #: point must wait for it — a point saved mid-walk would skip
+        #: the rest of the tree forever on restart.
+        self.bootstrap_done = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._channel = None
@@ -187,6 +193,8 @@ class Replicator:
         backoff = 0.2
         while not self._stop.is_set():
             try:
+                if not need_bootstrap:
+                    self.bootstrap_done.set()
                 if need_bootstrap:
                     # Attach the LIVE stream first (never needs log
                     # coverage, so a re-sync always converges), adopt
@@ -197,6 +205,7 @@ class Replicator:
                         nonlocal need_bootstrap
                         self._bootstrap()
                         need_bootstrap = False
+                        self.bootstrap_done.set()
                     self.last_ts_ns = 0
                     self._follow(on_attach=_walk_done)
                 else:
