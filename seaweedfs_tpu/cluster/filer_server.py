@@ -176,10 +176,17 @@ def entry_to_pb(e: Entry) -> filer_pb2.Entry:
 
 
 def pb_to_entry(directory: str, p: filer_pb2.Entry) -> Entry:
+    import time as _time
+
     a = p.attributes
+    # an unset timestamp means "now", like the HTTP write path — a raw
+    # 0 would make gRPC-created entries look 55 years idle to age-based
+    # sweeps (s3.clean.uploads, volume.deleteEmpty analogs)
+    now = _time.time()
     return Entry(
         path=normalize_path(f"{directory}/{p.name}"),
-        attr=Attr(mtime=float(a.mtime or 0), crtime=float(a.crtime or 0),
+        attr=Attr(mtime=float(a.mtime or now),
+                  crtime=float(a.crtime or now),
                   mode=a.file_mode or 0o660, uid=a.uid, gid=a.gid,
                   mime=a.mime, ttl_sec=a.ttl_sec,
                   collection=a.collection, replication=a.replication,

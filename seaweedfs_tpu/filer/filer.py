@@ -149,20 +149,12 @@ class Filer:
 
     def _ensure_parents(self, path: str,
                         signatures: tuple = ()) -> None:
-        parent, _ = split_path(path)
-        missing: list[str] = []
-        while parent != "/":
-            e = self.store.find_entry(parent)
-            if e is not None:
-                if not e.is_dir:
-                    raise FilerError(f"{parent} is not a directory")
-                break
-            missing.append(parent)
-            parent, _ = split_path(parent)
-        for p in reversed(missing):
-            d = Entry(path=p, attr=Attr(is_dir=True, mode=0o770))
-            self.store.insert_entry(d)
-            self._notify(split_path(p)[0], None, d, signatures)
+        try:
+            created = self.store.ensure_parents(path)
+        except ValueError as e:
+            raise FilerError(str(e)) from None
+        for d in created:
+            self._notify(split_path(d.path)[0], None, d, signatures)
 
     def list_entries(self, dir_path: str, start_name: str = "",
                      limit: int = 1 << 30) -> Iterator[Entry]:

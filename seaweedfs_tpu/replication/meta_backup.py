@@ -17,7 +17,7 @@ import time
 from typing import Optional
 
 from ..cluster.filer_client import FilerClient
-from ..filer.entry import Attr, Entry, normalize_path, split_path
+from ..filer.entry import normalize_path, split_path
 from ..filer.stores import SqliteStore
 from ..util import glog
 from ..util import tls as tls_mod
@@ -57,14 +57,7 @@ class MetaBackupSink(ReplicationSink):
         d, _name = split_path(path)
         entry = pb_to_entry(d, new_entry)
         # parents must exist for listings of the backup to make sense
-        missing = []
-        parent = d
-        while parent != "/" and self.store.find_entry(parent) is None:
-            missing.append(parent)
-            parent, _ = split_path(parent)
-        for p in reversed(missing):
-            self.store.insert_entry(Entry(path=p,
-                                          attr=Attr(is_dir=True)))
+        self.store.ensure_parents(path)
         if self.store.find_entry(path) is None:
             self.store.insert_entry(entry)
         else:
@@ -105,13 +98,25 @@ class MetaBackup:
 
     @staticmethod
     def _source_epoch(filer_url: str) -> int:
-        c = FilerClient(filer_url)
-        try:
-            return c.configuration().started_ns
-        except Exception:  # noqa: BLE001 — old source: epoch unknown
-            return 0
-        finally:
-            c.close()
+        """The source's process epoch. An UNREACHABLE source raises
+        (after retries) rather than returning a fake epoch: a 0 here
+        would both force a spurious full re-walk now and poison the
+        stored epoch into forcing another on the next restart. A
+        pre-started_ns source genuinely returns 0 (proto default) —
+        that stays consistent across restarts, so no churn."""
+        last: Exception | None = None
+        for _ in range(3):
+            c = FilerClient(filer_url)
+            try:
+                return c.configuration().started_ns
+            except Exception as e:  # noqa: BLE001 — retry below
+                last = e
+                time.sleep(0.5)
+            finally:
+                c.close()
+        raise RuntimeError(
+            f"filer {filer_url} unreachable while reading its epoch: "
+            f"{last}")
 
     def _persist_loop(self) -> None:
         last = 0
@@ -167,11 +172,11 @@ def restore(db_path: str, filer_url: str,
         while stack:
             d = stack.pop()
             for e in store.list_entries(d):
+                # directories restore through create too: mkdir would
+                # discard their backed-up mode/owners/xattrs
+                fc.create(d, entry_to_pb(e))
                 if e.is_dir:
-                    fc.mkdir(d, split_path(e.path)[1])
                     stack.append(e.path)
-                else:
-                    fc.create(d, entry_to_pb(e))
                 n += 1
     finally:
         fc.close()

@@ -218,10 +218,13 @@ class Replicator:
                     # Source says replay cannot converge (meta-log
                     # window expired, or we lagged past the queue
                     # bound) — full re-sync, even for noBootstrap
-                    # replicators.
+                    # replicators. The walk-complete flag drops with
+                    # it: a resume point persisted during the recovery
+                    # walk would skip the unwalked remainder forever.
                     glog.warning("replication: %s; re-syncing the "
                                  "tree", e)
                     need_bootstrap = True
+                    self.bootstrap_done.clear()
                 glog.v(1, "replication stream broke: %s", e)
                 # the channel may be the casualty — dial fresh next time
                 if self._channel is not None:

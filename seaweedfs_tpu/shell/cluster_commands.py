@@ -292,7 +292,7 @@ DESTRUCTIVE_COMMANDS = {
     "volume.vacuum", "volume.deleteEmpty", "volume.mark",
     "volumeServer.evacuate", "collection.delete", "volume.grow",
     "volume.tier.upload", "volume.tier.download", "volume.check.disk",
-    "s3.configure", "fs.configure", "volume.fsck",
+    "s3.configure", "fs.configure", "s3.clean.uploads", "volume.fsck",
     "volume.configure.replication",
 }
 

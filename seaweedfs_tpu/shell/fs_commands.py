@@ -231,6 +231,60 @@ def cmd_s3_bucket_delete(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"deleted bucket {args.name}")
 
 
+@cluster_command("s3.clean.uploads")
+def cmd_s3_clean_uploads(env: ClusterEnv, argv: list[str]) -> None:
+    """Abort multipart uploads older than -timeAgo
+    (command_s3_clean_uploads.go): a client that initiated an upload
+    and vanished leaves part data consuming volumes forever otherwise.
+    Age is measured from the NEWEST part, so an in-progress upload is
+    never reaped while parts keep arriving."""
+    import time as time_mod
+
+    p = _parser("s3.clean.uploads")
+    p.add_argument("-timeAgo", default="24h",
+                   help="abort uploads idle longer than this "
+                        "(e.g. 30m, 24h, 7d)")
+    p.add_argument("-force", action="store_true",
+                   help="actually delete (default: dry run)")
+    args = p.parse_args(argv)
+    unit = args.timeAgo[-1]
+    per = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    if unit not in per or not args.timeAgo[:-1].isdigit():
+        raise ShellError(
+            f"s3.clean.uploads: bad -timeAgo {args.timeAgo!r} "
+            f"(want <n>[smhd])")
+    cutoff = time_mod.time() - int(args.timeAgo[:-1]) * per[unit]
+    fc = _fc(env)
+    uploads_dir = f"{_BUCKETS_DIR}/.uploads"
+    reaped = kept = 0
+    for e in fc.list(uploads_dir):
+        if not e.is_directory:
+            continue
+        newest = e.attributes.mtime
+        key = bucket = ""
+        for part in fc.list(f"{uploads_dir}/{e.name}"):
+            newest = max(newest, part.attributes.mtime)
+            if part.name == "key":
+                key = part.extended.get("key", b"").decode("utf-8",
+                                                           "replace")
+                bucket = part.extended.get(
+                    "bucket", b"").decode("utf-8", "replace")
+        if newest >= cutoff:
+            kept += 1
+            continue
+        idle_h = (time_mod.time() - newest) / 3600
+        env.println(
+            f"upload {e.name} ({bucket}/{key}) idle {idle_h:.1f}h"
+            + ("" if args.force else " (dry run; use -force)"))
+        if args.force:
+            fc.delete(uploads_dir, e.name, recursive=True,
+                      delete_data=True)
+        reaped += 1
+    env.println(f"s3.clean.uploads: {reaped} stale uploads"
+                + (" aborted" if args.force else " found")
+                + f", {kept} active kept")
+
+
 @cluster_command("fs.configure")
 def cmd_fs_configure(env: ClusterEnv, argv: list[str]) -> None:
     """Manage per-path storage rules (command_fs_configure.go): writes

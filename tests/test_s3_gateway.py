@@ -62,7 +62,10 @@ def _req(gw, method, path, data=None, headers=None, query=""):
     url = f"http://{gw.url}{path}" + (f"?{query}" if query else "")
     req = urllib.request.Request(url, data=data, method=method,
                                  headers=headers or {})
-    return urllib.request.urlopen(req, timeout=30)
+    # 120s: multi-chunk PUTs traverse gateway->filer->volume on one
+    # core; under a deliberate CPU antagonist (flake_hunt4) a 30s
+    # client timeout fires on load alone and reads as a flake
+    return urllib.request.urlopen(req, timeout=120)
 
 
 def test_bucket_lifecycle(s3):
@@ -478,3 +481,58 @@ def test_verifier_fails_closed_when_config_unavailable():
         v.verify("GET", "/", "", {}, "")
     v.set_identities(None)  # confirmed no-config -> open again
     assert v.verify("GET", "/", "", {}, "") is None
+
+
+def test_s3_clean_uploads(s3):
+    """Stale multipart uploads are reaped by age of their newest part;
+    active ones survive."""
+    import io
+
+    from seaweedfs_tpu.shell import fs_commands  # noqa: F401
+    from seaweedfs_tpu.shell.cluster_commands import (
+        ClusterEnv, run_cluster_command)
+
+    _req(s3, "PUT", "/clnbkt")
+    # stale upload: initiate, add one part, then age every entry
+    body = _req(s3, "POST", "/clnbkt/stale.bin?uploads").read()
+    stale_id = ET.fromstring(body).find(f"{NS}UploadId").text
+    _req(s3, "PUT", f"/clnbkt/stale.bin?uploadId={stale_id}&partNumber=1",
+         data=b"p" * 100)
+    # fresh upload: just initiated
+    body = _req(s3, "POST", "/clnbkt/fresh.bin?uploads").read()
+    fresh_id = ET.fromstring(body).find(f"{NS}UploadId").text
+
+    up_dir = f"/buckets/.uploads/{stale_id}"
+    for e in list(s3.filer.list(up_dir)) + \
+            [s3.filer.lookup("/buckets/.uploads", stale_id)]:
+        e.attributes.mtime = int(time.time()) - 48 * 3600
+        d = up_dir if e.name != stale_id else "/buckets/.uploads"
+        s3.filer.create(d, e)
+
+    # the gateway's filer url doubles as the shell's; master unused
+    env = ClusterEnv(master_url="127.0.0.1:1",
+                     filer_url=s3.filer.filer_url, out=io.StringIO())
+    try:
+        fn = fs_commands.cmd_s3_clean_uploads
+        out = env.out
+        fn(env, ["-timeAgo", "24h"])
+        assert "dry run" in out.getvalue()
+        assert s3.filer.lookup("/buckets/.uploads", stale_id) is not None
+        fn(env, ["-timeAgo", "24h", "-force"])
+        assert "1 stale uploads aborted" in out.getvalue()
+        assert "1 active kept" in out.getvalue()
+        assert s3.filer.lookup("/buckets/.uploads", stale_id) is None
+        assert s3.filer.lookup("/buckets/.uploads", fresh_id) is not None
+        # the fresh upload still completes
+        with _req(s3, "PUT",
+                  f"/clnbkt/fresh.bin?uploadId={fresh_id}&partNumber=1",
+                  data=b"z" * 10) as r:
+            etag = r.headers["ETag"]
+        xml = (f'<CompleteMultipartUpload><Part><PartNumber>1'
+               f'</PartNumber><ETag>{etag}</ETag></Part>'
+               f'</CompleteMultipartUpload>')
+        _req(s3, "POST", f"/clnbkt/fresh.bin?uploadId={fresh_id}",
+             data=xml.encode())
+        assert _req(s3, "GET", "/clnbkt/fresh.bin").read() == b"z" * 10
+    finally:
+        env.close()
