@@ -559,6 +559,18 @@ class _VolumeServicer:
             resp.error = str(e)
         return resp
 
+    def VolumeMount(self, request, context):
+        self.vs.store.mount_volume(request.volume_id,
+                                   request.collection)
+        self.vs.heartbeat_now()
+        return volume_server_pb2.VolumeMountResponse()
+
+    def VolumeUnmount(self, request, context):
+        self.vs.store.unmount_volume(request.volume_id,
+                                     request.collection)
+        self.vs.heartbeat_now()
+        return volume_server_pb2.VolumeUnmountResponse()
+
     def ReadNeedleBlob(self, request, context):
         """Raw record bytes for one live needle (the replica-sync read
         behind volume.check.disk; reference volume_grpc_read_write.go

@@ -106,31 +106,6 @@ class MemoryStore(FilerStore):
             entries = [self._entries[p].clone() for p in picked]
         yield from entries
 
-    def ensure_parents(self, path: str,
-                       mode: int = 0o770) -> list:
-        """Insert missing ancestor directories of ``path``; returns
-        the created entries shallowest-first (the one parent-synthesis
-        invariant shared by the live filer and backup sinks). Raises
-        ValueError when an ancestor exists as a file."""
-        from .entry import Attr, Entry, split_path
-
-        parent, _ = split_path(path)
-        missing: list[str] = []
-        while parent != "/":
-            e = self.find_entry(parent)
-            if e is not None:
-                if not e.is_dir:
-                    raise ValueError(f"{parent} is not a directory")
-                break
-            missing.append(parent)
-            parent, _ = split_path(parent)
-        created = []
-        for p in reversed(missing):
-            d = Entry(path=p, attr=Attr(is_dir=True, mode=mode))
-            self.insert_entry(d)
-            created.append(d)
-        return created
-
     def kv_put(self, key: str, value: bytes) -> None:
         with self._lock:
             self._kv[key] = bytes(value)
@@ -199,31 +174,6 @@ class SqliteStore(FilerStore):
             (normalize_path(dir_path), start_name, limit)).fetchall()
         for (meta,) in rows:
             yield Entry.from_dict(json.loads(meta))
-
-    def ensure_parents(self, path: str,
-                       mode: int = 0o770) -> list:
-        """Insert missing ancestor directories of ``path``; returns
-        the created entries shallowest-first (the one parent-synthesis
-        invariant shared by the live filer and backup sinks). Raises
-        ValueError when an ancestor exists as a file."""
-        from .entry import Attr, Entry, split_path
-
-        parent, _ = split_path(path)
-        missing: list[str] = []
-        while parent != "/":
-            e = self.find_entry(parent)
-            if e is not None:
-                if not e.is_dir:
-                    raise ValueError(f"{parent} is not a directory")
-                break
-            missing.append(parent)
-            parent, _ = split_path(parent)
-        created = []
-        for p in reversed(missing):
-            d = Entry(path=p, attr=Attr(is_dir=True, mode=mode))
-            self.insert_entry(d)
-            created.append(d)
-        return created
 
     def kv_put(self, key: str, value: bytes) -> None:
         con = self._con()

@@ -61,6 +61,10 @@ VOLUME_METHODS = [
            volume_server_pb2.VolumeStatusResponse),
     Method("VolumeConfigure", volume_server_pb2.VolumeConfigureRequest,
            volume_server_pb2.VolumeConfigureResponse),
+    Method("VolumeMount", volume_server_pb2.VolumeMountRequest,
+           volume_server_pb2.VolumeMountResponse),
+    Method("VolumeUnmount", volume_server_pb2.VolumeUnmountRequest,
+           volume_server_pb2.VolumeUnmountResponse),
     Method("CopyFile", volume_server_pb2.CopyFileRequest,
            volume_server_pb2.CopyFileResponse, SERVER_STREAM),
     Method("ReadNeedleBlob", volume_server_pb2.ReadNeedleBlobRequest,

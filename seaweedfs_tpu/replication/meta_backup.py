@@ -105,13 +105,14 @@ class MetaBackup:
         pre-started_ns source genuinely returns 0 (proto default) —
         that stays consistent across restarts, so no churn."""
         last: Exception | None = None
-        for _ in range(3):
+        for attempt in range(3):
             c = FilerClient(filer_url)
             try:
                 return c.configuration().started_ns
             except Exception as e:  # noqa: BLE001 — retry below
                 last = e
-                time.sleep(0.5)
+                if attempt < 2:
+                    time.sleep(0.5)
             finally:
                 c.close()
         raise RuntimeError(

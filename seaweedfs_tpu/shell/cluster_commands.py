@@ -293,6 +293,7 @@ DESTRUCTIVE_COMMANDS = {
     "volumeServer.evacuate", "collection.delete", "volume.grow",
     "volume.tier.upload", "volume.tier.download", "volume.check.disk",
     "s3.configure", "fs.configure", "s3.clean.uploads", "volume.fsck",
+    "volume.mount", "volume.unmount",
     "volume.configure.replication",
 }
 
@@ -1167,6 +1168,37 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"volume.check.disk: {checked} replicated volumes "
                 f"checked, {divergent} divergent replicas, "
                 f"{synced} needles synced, {skews} unresolved skews")
+
+
+@cluster_command("volume.unmount")
+def cmd_volume_unmount(env: ClusterEnv, argv: list[str]) -> None:
+    """Stop serving a volume on one server, keeping its files
+    (command_volume_unmount.go) — the maintenance verb before moving a
+    volume directory by hand."""
+    p = _parser("volume.unmount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-node", required=True, help="server ip:port")
+    args = p.parse_args(argv)
+    env.volume(args.node).VolumeUnmount(
+        volume_server_pb2.VolumeUnmountRequest(
+            volume_id=args.volumeId, collection=args.collection))
+    env.println(f"volume.unmount: volume {args.volumeId} unmounted "
+                f"on {args.node} (files kept)")
+
+
+@cluster_command("volume.mount")
+def cmd_volume_mount(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("volume.mount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-node", required=True, help="server ip:port")
+    args = p.parse_args(argv)
+    env.volume(args.node).VolumeMount(
+        volume_server_pb2.VolumeMountRequest(
+            volume_id=args.volumeId, collection=args.collection))
+    env.println(f"volume.mount: volume {args.volumeId} mounted "
+                f"on {args.node}")
 
 
 @cluster_command("volume.configure.replication")

@@ -247,8 +247,8 @@ def cmd_s3_clean_uploads(env: ClusterEnv, argv: list[str]) -> None:
     p.add_argument("-force", action="store_true",
                    help="actually delete (default: dry run)")
     args = p.parse_args(argv)
-    unit = args.timeAgo[-1]
     per = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    unit = args.timeAgo[-1] if args.timeAgo else ""
     if unit not in per or not args.timeAgo[:-1].isdigit():
         raise ShellError(
             f"s3.clean.uploads: bad -timeAgo {args.timeAgo!r} "

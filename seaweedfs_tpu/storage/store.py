@@ -262,6 +262,40 @@ class Store:
         self.readonly.discard((collection, volume_id))
         return vol.dat_size
 
+    def unmount_volume(self, volume_id: int,
+                       collection: str = "") -> None:
+        """Stop serving a volume but KEEP its files (the reference's
+        VolumeUnmount): the maintenance verb for moving a volume
+        directory by hand or freezing it for external tooling."""
+        vol = self.get_volume(volume_id, collection)
+        vol.close()
+        del self.volumes[(collection, volume_id)]
+        # the readonly mark is deliberately KEPT: an operator (or the
+        # ec.encode/move choreography) that froze the volume must not
+        # find it silently writable again after an unmount/mount cycle
+
+    def mount_volume(self, volume_id: int,
+                     collection: str = "") -> None:
+        """(Re)open a volume whose files are already in a location
+        (VolumeMount): the inverse of unmount_volume."""
+        if (collection, volume_id) in self.volumes:
+            return
+        from . import tier as tier_mod
+        for loc in self.locations:
+            base = loc.directory / volume_base_name(volume_id,
+                                                    collection)
+            if dat_path(base).exists() or \
+                    tier_mod.TierInfo.path_for(base).exists():
+                vol = Volume(base, volume_id, backend=self.backend,
+                             needle_map=self.needle_map).load()
+                self.volumes[(collection, volume_id)] = vol
+                if vol.readonly:
+                    self.readonly.add((collection, volume_id))
+                return
+        raise StoreError(
+            f"no files for volume {volume_id} "
+            f"(collection {collection!r}) in any location")
+
     def delete_volume(self, volume_id: int, collection: str = "") -> None:
         """Drop the .dat/.idx (ec.encode's final step deletes the source
         volume this way)."""

@@ -533,3 +533,36 @@ def test_shell_volume_configure_replication(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_shell_volume_unmount_mount(cluster):
+    from seaweedfs_tpu.storage.volume import dat_path
+
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        fids = operation.submit(mc, [b"park-me"])
+        vid = int(fids[0].split(",")[0])
+        _settle(servers)
+        holder = next(vs for vs in servers if vs.store.has_volume(vid))
+        base = holder.store.get_volume(vid).base
+
+        env, out = _env(master)
+        run_cluster_command(
+            env, f"volume.unmount -volumeId {vid} -node {holder.url}")
+        assert not holder.store.has_volume(vid)
+        assert dat_path(base).exists()  # files kept
+        _settle(servers)
+        mc.invalidate()
+        with pytest.raises(Exception):
+            operation.download(mc, fids[0])
+
+        run_cluster_command(
+            env, f"volume.mount -volumeId {vid} -node {holder.url}")
+        assert holder.store.has_volume(vid)
+        _settle(servers)
+        mc.invalidate()
+        assert operation.download(mc, fids[0]) == b"park-me"
+        env.close()
+    finally:
+        mc.close()
