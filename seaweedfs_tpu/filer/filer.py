@@ -110,11 +110,33 @@ class Filer:
 
     # ------------- namespace -------------
 
+    @staticmethod
+    def _expired(entry: Entry) -> bool:
+        """Entry-level TTL (reference filer behavior): an entry whose
+        volume-TTL lifetime has passed reads as absent — the blob layer
+        reaps the chunk data on the same clock, so surfacing the entry
+        would only produce dangling-chunk 404s."""
+        return bool(entry.attr.ttl_sec) and not entry.is_dir and \
+            time.time() > entry.attr.crtime + entry.attr.ttl_sec
+
     def find_entry(self, path: str) -> Optional[Entry]:
         path = normalize_path(path)
         if path == "/":
             return Entry(path="/", attr=Attr(is_dir=True))
-        return self.store.find_entry(path)
+        e = self.store.find_entry(path)
+        if e is not None and self._expired(e):
+            # lazy reap — re-resolved UNDER the namespace lock: a
+            # writer may have recreated the path since the unlocked
+            # read, and deleting by path alone would destroy the fresh
+            # entry (chunks are volume-reaped; only metadata goes)
+            with self._ns_lock:
+                cur = self.store.find_entry(path)
+                if cur is not None and self._expired(cur):
+                    self.store.delete_entry(path)
+                    self._notify(split_path(path)[0], cur, None)
+                    return None
+                e = cur
+        return e
 
     def create_entry(self, entry: Entry, o_excl: bool = False,
                      signatures: tuple = ()) -> Entry:
@@ -158,7 +180,17 @@ class Filer:
 
     def list_entries(self, dir_path: str, start_name: str = "",
                      limit: int = 1 << 30) -> Iterator[Entry]:
-        return self.store.list_entries(dir_path, start_name, limit)
+        # filter BEFORE counting the page: limiting at the store and
+        # filtering after could return a short/empty page with live
+        # entries still ahead, which paginating clients read as EOF
+        n = 0
+        for e in self.store.list_entries(dir_path, start_name):
+            if self._expired(e):
+                continue
+            yield e
+            n += 1
+            if n >= limit:
+                return
 
     def delete_entry(self, path: str, recursive: bool = False,
                      signatures: tuple = ()) -> list[FileChunk]:

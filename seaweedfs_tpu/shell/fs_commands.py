@@ -436,6 +436,9 @@ def _entry_to_json(directory: str, e) -> dict:
             "fileMode": e.attributes.file_mode,
             "crtime": e.attributes.crtime,
             "mime": e.attributes.mime,
+            "ttlSec": e.attributes.ttl_sec,
+            "collection": e.attributes.collection,
+            "replication": e.attributes.replication,
         },
         "chunks": [{"fileId": c.file_id, "offset": c.offset,
                     "size": c.size, "mtime_ns": c.mtime_ns}
@@ -453,12 +456,32 @@ def _entry_from_json(d: dict) -> filer_pb2.Entry:
     e.attributes.file_mode = a.get("fileMode", 0)
     e.attributes.crtime = a.get("crtime", 0)
     e.attributes.mime = a.get("mime", "")
+    e.attributes.ttl_sec = a.get("ttlSec", 0)
+    e.attributes.collection = a.get("collection", "")
+    e.attributes.replication = a.get("replication", "")
     for c in d.get("chunks", []):
         e.chunks.add(file_id=c["fileId"], offset=c["offset"],
                      size=c["size"], mtime_ns=c.get("mtime_ns", 0))
     for k, v in d.get("extended", {}).items():
         e.extended[k] = v.encode("latin-1")
     return e
+
+
+@cluster_command("fs.meta.cat")
+def cmd_fs_meta_cat(env: ClusterEnv, argv: list[str]) -> None:
+    """Print one entry's full metadata as JSON (command_fs_meta_cat.go)
+    — the debugging verb for inspecting chunk manifests and extended
+    attributes."""
+    p = _parser("fs.meta.cat")
+    p.add_argument("path")
+    args = p.parse_args(argv)
+    fc = _fc(env)
+    path = _norm(args.path)
+    d, _, n = path.rpartition("/")
+    e = fc.lookup(d or "/", n)
+    if e is None:
+        raise ShellError(f"{path} not found")
+    env.println(json.dumps(_entry_to_json(d or "/", e), indent=2))
 
 
 @cluster_command("fs.meta.save")

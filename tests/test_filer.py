@@ -135,3 +135,32 @@ class TestMetaLog:
         assert events[0].old_entry is None
         assert events[1].new_entry is None
         assert events[1].old_entry.path == "/n1"
+
+
+def test_entry_ttl_lazy_expiry():
+    """Entries past their volume-TTL lifetime read as absent and are
+    lazily reaped (the reference filer hides expired entries; the blob
+    layer reaps chunk data on the same clock)."""
+    import time as time_mod
+
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+
+    f = Filer()
+    f.create_entry(Entry(path="/ttl/short.txt",
+                         attr=Attr(ttl_sec=1,
+                                   crtime=time_mod.time() - 5)))
+    f.create_entry(Entry(path="/ttl/long.txt",
+                         attr=Attr(ttl_sec=3600)))
+    f.create_entry(Entry(path="/ttl/forever.txt", attr=Attr()))
+    # expired entry is invisible everywhere
+    assert f.find_entry("/ttl/short.txt") is None
+    names = {e.name for e in f.list_entries("/ttl")}
+    assert names == {"long.txt", "forever.txt"}
+    # and the lazy reap actually removed it from the store
+    assert f.store.find_entry("/ttl/short.txt") is None
+    # directories never expire (ttl_sec on a dir is metadata only)
+    f.create_entry(Entry(path="/ttl2/d",
+                         attr=Attr(is_dir=True, ttl_sec=1,
+                                   crtime=time_mod.time() - 5)))
+    assert f.find_entry("/ttl2/d") is not None

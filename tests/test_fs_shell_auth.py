@@ -374,3 +374,24 @@ def test_fs_configure_rejects_bad_rules(stack):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req, timeout=30)
     assert ei.value.code == 400
+
+
+def test_fs_meta_cat(stack):
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+
+    _, _, filer = stack
+    fc = FilerClient(filer.url)
+    try:
+        fc.put_data("/mc/x.txt", b"meta-cat-me")
+        out = _shell(stack, "fs.meta.cat /mc/x.txt")
+        doc = json.loads(out)
+        assert doc["name"] == "x.txt"
+        assert doc["chunks"] and doc["chunks"][0]["fileId"]
+        err = None
+        try:
+            _shell(stack, "fs.meta.cat /mc/none.txt")
+        except ShellError as e:
+            err = str(e)
+        assert err and "not found" in err
+    finally:
+        fc.close()

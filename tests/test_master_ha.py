@@ -4,6 +4,7 @@ failover (weed/server/raft_server.go role, SURVEY.md §2 "Raft")."""
 import json
 import socket
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -168,18 +169,31 @@ def test_follower_proxies_lookup_and_grow(ha_cluster):
     _wait_for(lambda: len(leader.topology.nodes) == 1,
               what="volume server registration")
     follower = next(m for m in masters if not m.is_leader)
+
+    def _retry_503(req):
+        # mid-election the proxy answers 503 (the documented client
+        # retry signal); under a CPU antagonist spurious re-elections
+        # happen, so retry like a real client instead of flaking
+        deadline = time.time() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code != 503 or time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+
     # POST /vol/grow on a follower must reach the leader with its method
-    req = urllib.request.Request(
-        f"http://{follower.url}/vol/grow?count=1", method="POST")
-    with urllib.request.urlopen(req, timeout=10) as r:
-        grown = json.loads(r.read())
+    grown = _retry_503(urllib.request.Request(
+        f"http://{follower.url}/vol/grow?count=1", method="POST"))
     assert grown.get("count") == 1, grown
     vid = grown["volumeIds"][0]
     # /dir/lookup on the follower answers from the leader's topology
-    _wait_for(lambda: leader.topology.lookup_volume(vid, ""),
-              what="grown volume registered")
-    with urllib.request.urlopen(
-            f"http://{follower.url}/dir/lookup?volumeId={vid}",
-            timeout=10) as r:
-        looked = json.loads(r.read())
+    def _grown_registered():
+        l = _one_leader(masters)  # None mid-election: keep waiting
+        return l is not None and l.topology.lookup_volume(vid, "")
+    _wait_for(_grown_registered, what="grown volume registered")
+    looked = _retry_503(urllib.request.Request(
+        f"http://{follower.url}/dir/lookup?volumeId={vid}"))
     assert looked.get("locations"), looked

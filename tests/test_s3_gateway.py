@@ -457,10 +457,21 @@ def test_filer_config_identities_live_reload(tmp_path_factory):
             except urllib.error.HTTPError:
                 time.sleep(0.1)
         assert ok, "gateway never picked up the new identity"
-        # the deleted identity is refused now
-        with pytest.raises(urllib.error.HTTPError) as ei:
-            signed_put("/fcbkt3", "BOOTAK", "BOOTSK")
-        assert ei.value.code == 403
+        # the deleted identity is refused once ITS reload lands — the
+        # add and the delete are separate events, so LIVEAK working
+        # only proves the first reload; poll for the second
+        deadline = time.time() + 15
+        code = None
+        while time.time() < deadline:
+            try:
+                signed_put("/fcbkt3", "BOOTAK", "BOOTSK")
+            except urllib.error.HTTPError as e:
+                if e.code == 403:
+                    code = 403
+                    break
+                # transient mid-reload error: keep polling
+            time.sleep(0.1)
+        assert code == 403, "gateway never dropped the old identity"
     finally:
         gw.stop()
         fc.close()
