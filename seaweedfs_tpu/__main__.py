@@ -117,6 +117,11 @@ def _run_filer_meta_backup(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_filer_copy(argv: list[str]) -> int:
+    from .cli_tools import run_filer_copy
+    return run_filer_copy(argv)
+
+
 def _run_fix(argv: list[str]) -> int:
     from .volume_tools import run_fix
     return run_fix(argv)
@@ -167,6 +172,7 @@ COMMANDS = {
     "filer.replicate": _run_filer_replicate,
     "filer.sync": _run_filer_sync,
     "filer.meta.backup": _run_filer_meta_backup,
+    "filer.copy": _run_filer_copy,
     "fix": _run_fix,
     "backup": _run_backup,
     "export": _run_export,

@@ -168,3 +168,86 @@ def run_benchmark(argv: list[str]) -> int:
                       **{k: round(v, 2) for k, v in wstats.items()}}))
     master.close()
     return 0
+
+
+def run_filer_copy(argv: list[str] | None = None) -> int:
+    """``weed filer.copy <paths...> http://<filer>/<dir>/`` — upload
+    local files or whole directory trees into the filer namespace
+    (weed/command/filer_copy.go). Parallelism stays sequential: the
+    single-core build gains nothing from upload workers."""
+    import argparse
+    import urllib.parse
+    from pathlib import Path as _Path
+
+    from .cluster.filer_client import FilerClient
+
+    p = argparse.ArgumentParser(prog="filer.copy")
+    p.add_argument("paths", nargs="+",
+                   help="local files/directories, last arg is the "
+                        "filer url (http://host:port/dir/)")
+    p.add_argument("-collection", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("-maxMB", type=int, default=0,
+                   help="chunk size override")
+    args = p.parse_args(argv)
+    if len(args.paths) < 2:
+        print("filer.copy: need at least one source and the filer url")
+        return 1
+    *sources, dest = args.paths
+    u = urllib.parse.urlparse(dest)
+    if u.scheme != "http" or not u.netloc:
+        print(f"filer.copy: destination must be http://filer/dir/ "
+              f"(got {dest!r})")
+        return 1
+    base = u.path if u.path.endswith("/") else u.path + "/"
+    fc = FilerClient(u.netloc)
+    params = {}
+    if args.collection:
+        params["collection"] = args.collection
+    if args.ttl:
+        params["ttl"] = args.ttl
+    if args.maxMB:
+        params["maxMB"] = str(args.maxMB)
+    query = urllib.parse.urlencode(params)
+    window = (args.maxMB or 8) * 1024 * 1024
+    copied = failed = 0
+    try:
+        for src in sources:
+            sp = _Path(src)
+            if sp.is_dir():
+                files = sorted(x for x in sp.rglob("*") if x.is_file())
+                rels = [(x, f"{sp.name}/{x.relative_to(sp)}")
+                        for x in files]
+            elif sp.is_file():
+                rels = [(sp, sp.name)]
+            else:
+                print(f"filer.copy: {src}: no such file or directory")
+                failed += 1
+                continue
+            for local, rel in rels:
+                target = base + rel
+                try:
+                    # stream in windows: the first PUT creates the
+                    # entry, the rest append — a multi-GB file never
+                    # sits in RAM whole (filer_copy.go streams too)
+                    with open(local, "rb") as f:
+                        first = True
+                        while True:
+                            piece = f.read(window)
+                            if not piece and not first:
+                                break
+                            qx = query if first else (
+                                f"{query}&op=append" if query
+                                else "op=append")
+                            fc.put_data(target, piece, query=qx)
+                            first = False
+                    copied += 1
+                    print(f"{local} -> {target}")
+                except Exception as e:  # noqa: BLE001 — keep copying
+                    failed += 1
+                    print(f"filer.copy: {local}: {e}")
+    finally:
+        fc.close()
+    print(f"filer.copy: {copied} files copied"
+          + (f", {failed} FAILED" if failed else ""))
+    return 1 if failed else 0

@@ -180,17 +180,28 @@ class Filer:
 
     def list_entries(self, dir_path: str, start_name: str = "",
                      limit: int = 1 << 30) -> Iterator[Entry]:
-        # filter BEFORE counting the page: limiting at the store and
+        # Filter BEFORE counting the page (limiting at the store and
         # filtering after could return a short/empty page with live
-        # entries still ahead, which paginating clients read as EOF
+        # entries still ahead, which paginating clients read as EOF) —
+        # but keep the store fetches BOUNDED: batches of page size,
+        # advancing the name cursor, so a huge directory costs
+        # O(page), not O(dir), per request.
         n = 0
-        for e in self.store.list_entries(dir_path, start_name):
-            if self._expired(e):
-                continue
-            yield e
-            n += 1
-            if n >= limit:
+        cursor = start_name
+        while n < limit:
+            batch_size = min(max(limit - n, 64), 4096)
+            batch = list(self.store.list_entries(dir_path, cursor,
+                                                 batch_size))
+            if not batch:
                 return
+            for e in batch:
+                if self._expired(e):
+                    continue
+                yield e
+                n += 1
+                if n >= limit:
+                    return
+            cursor = split_path(batch[-1].path)[1]
 
     def delete_entry(self, path: str, recursive: bool = False,
                      signatures: tuple = ()) -> list[FileChunk]:
@@ -204,8 +215,15 @@ class Filer:
             orphans: list[FileChunk] = []
             if entry.is_dir:
                 children = list(self.store.list_entries(path))
-                if children and not recursive:
+                # only LIVE children make a directory "not empty":
+                # listings hide expired entries, so refusing a delete
+                # over them would contradict what the client sees
+                # (their metadata is reaped by the recursion below)
+                live = [c for c in children if not self._expired(c)]
+                if live and not recursive:
                     raise FilerError(f"{path} is not empty")
+                if children and not live:
+                    recursive = True  # only expired stragglers
                 for child in children:
                     orphans.extend(self.delete_entry(
                         child.path, recursive=True,

@@ -192,3 +192,41 @@ def test_backup_incremental_and_after_vacuum(cluster, tmp_path, capsys):
         assert "incremental" in out or "full" in out
     finally:
         mc.close()
+
+
+def test_filer_copy_uploads_trees(cluster, tmp_path, capsys):
+    from seaweedfs_tpu import cli_tools
+    from seaweedfs_tpu.cluster.filer_client import FilerClient
+    from seaweedfs_tpu.cluster.filer_server import FilerServer
+    from seaweedfs_tpu.filer import Filer
+
+    master, _ = cluster
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    fc = FilerClient(filer.url)
+    try:
+        (tmp_path / "one.txt").write_bytes(b"first")
+        tree = tmp_path / "tree" / "sub"
+        tree.mkdir(parents=True)
+        (tree.parent / "a.bin").write_bytes(b"aa")
+        (tree / "b.bin").write_bytes(b"bb" * 100)
+
+        rc = cli_tools.run_filer_copy(
+            [str(tmp_path / "one.txt"), str(tree.parent),
+             f"http://{filer.url}/dst/"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "3 files copied" in out
+        assert fc.get_data("/dst/one.txt") == b"first"
+        assert fc.get_data("/dst/tree/a.bin") == b"aa"
+        assert fc.get_data("/dst/tree/sub/b.bin") == b"bb" * 100
+
+        # missing source: reported, nonzero exit, others still copied
+        rc = cli_tools.run_filer_copy(
+            [str(tmp_path / "gone.txt"), str(tmp_path / "one.txt"),
+             f"http://{filer.url}/dst2/"])
+        assert rc == 1
+        assert fc.get_data("/dst2/one.txt") == b"first"
+    finally:
+        fc.close()
+        filer.stop()

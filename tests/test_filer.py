@@ -164,3 +164,19 @@ def test_entry_ttl_lazy_expiry():
                          attr=Attr(is_dir=True, ttl_sec=1,
                                    crtime=time_mod.time() - 5)))
     assert f.find_entry("/ttl2/d") is not None
+
+
+def test_delete_dir_with_only_expired_children():
+    import time as time_mod
+
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+
+    f = Filer()
+    f.create_entry(Entry(path="/exp/x", attr=Attr(
+        ttl_sec=1, crtime=time_mod.time() - 10)))
+    # listing shows the dir empty, so non-recursive delete must work
+    assert list(f.list_entries("/exp")) == []
+    f.delete_entry("/exp", recursive=False)
+    assert f.store.find_entry("/exp") is None
+    assert f.store.find_entry("/exp/x") is None
