@@ -224,6 +224,12 @@ class VolumeServer:
         raise last
 
     def _heartbeat_snapshot(self) -> master_pb2.Heartbeat:
+        # disk-reality self-heal belongs to the heartbeat path, not to
+        # read-only status() callers like volume.list
+        try:
+            self.store.reconcile_ec_shards()
+        except Exception as e:  # noqa: BLE001 — never kill a heartbeat
+            glog.warning("ec reconcile failed: %s", e)
         st = self.store.status()
         hb = master_pb2.Heartbeat(
             ip=self.ip, port=self.port, public_url=self.public_url,

@@ -453,6 +453,40 @@ class Store:
 
     # -- status / heartbeat ----------------------------------------------
 
+    def reconcile_ec_shards(self) -> None:
+        """Heartbeat-path self-heal: align EC mounts with DISK REALITY
+        so shard files lost underneath a running server (disk fault,
+        operator rm) drop out of the next snapshot — the master's
+        topology, ec.rebuild's missing-shard view, and peers' read
+        routing stay truthful instead of trusting a stale mount table.
+
+        Called from the heartbeat loop only (never from read-only
+        snapshots like volume.list): one directory scan per location
+        per pulse, shards counted present if ANY location holds them
+        (ec.balance moves shards between locations without updating
+        the mount base). Defensive pops: admin RPC threads mutate the
+        mount table concurrently."""
+        from ..util import glog
+
+        reality: dict[tuple[str, int], set] = {}
+        for loc in self.locations:
+            for col, vid, _base, ids in loc.scan_ec_shards():
+                reality.setdefault((col, vid), set()).update(ids)
+        for key in list(self.ec_mounts):
+            m = self.ec_mounts.get(key)
+            if m is None:
+                continue
+            present = reality.get(key, set())
+            gone = sorted(set(m.shard_ids) - present)
+            if not gone:
+                continue
+            glog.warning(
+                "volume %d: ec shard file(s) %s vanished from disk; "
+                "unmounting them", key[1], gone)
+            m.shard_ids.intersection_update(present)
+            if not m.shard_ids:
+                self.ec_mounts.pop(key, None)
+
     def status(self) -> dict:
         """Snapshot for heartbeats (§3.4): normal volumes + EC shard bits,
         the payload SendHeartbeat streams to the master."""

@@ -566,3 +566,44 @@ def test_shell_volume_unmount_mount(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_heartbeat_self_heals_vanished_shard_file(cluster):
+    """A shard file lost under a running server (disk fault, operator
+    rm) drops out of the next heartbeat WITHOUT a manual unmount, so
+    ec.rebuild sees the gap and repairs it."""
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        rng = np.random.default_rng(23)
+        blobs = [rng.integers(0, 256, 1500, dtype=np.uint8).tobytes()
+                 for _ in range(6)]
+        fids = operation.submit(mc, blobs)
+        vid = int(fids[0].split(",")[0])
+        env, out = _env(master)
+        run_cluster_command(env, f"ec.encode -volumeId {vid}")
+        _settle(servers)
+        victim = next(vs for vs in servers
+                      if any(v == vid for (_c, v) in vs.store.ec_mounts))
+        m = next(m for (c, v), m in victim.store.ec_mounts.items()
+                 if v == vid)
+        lost = sorted(m.shard_ids)[0]
+        ec_files.shard_path(m.base, lost).unlink()
+        # NO manual unmount: the next heartbeat snapshot must notice
+        _settle(servers)
+        assert lost not in m.shard_ids
+        assert lost not in master.topology.lookup_ec_volume(vid)
+        run_cluster_command(env, "ec.rebuild")
+        assert f"rebuilt [{lost}]" in out.getvalue()
+        _settle(servers)
+        assert sorted(master.topology.lookup_ec_volume(vid)) == \
+            list(range(14))
+        # data still reads end to end
+        mc.invalidate()
+        keep = [(f, b) for f, b in zip(fids, blobs)
+                if int(f.split(",")[0]) == vid]
+        for f, b in keep:
+            assert operation.download(mc, f) == b
+        env.close()
+    finally:
+        mc.close()
