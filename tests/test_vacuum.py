@@ -211,9 +211,16 @@ def test_cluster_vacuum_via_shell_and_master_scan(tmp_path):
         # master scan path: create fresh garbage, let scan pick it up
         for i in range(31, 39):
             store.delete_needle(7, i)
-        vs.heartbeat_now()
-        time.sleep(0.1)
-        assert master.scan_and_vacuum(threshold=0.3) == 1
+        # the scan reads the master's topology, which only updates on
+        # a completed heartbeat round trip — poll instead of a fixed
+        # sleep (0.1s starves under deliberate CPU-antagonist load)
+        deadline = time.time() + 15
+        n = 0
+        while time.time() < deadline and n == 0:
+            vs.heartbeat_now()
+            time.sleep(0.1)
+            n = master.scan_and_vacuum(threshold=0.3)
+        assert n == 1
         assert store.garbage_ratio(7) == 0.0
     finally:
         vs.stop()
