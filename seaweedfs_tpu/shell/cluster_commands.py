@@ -494,18 +494,34 @@ def cmd_ec_balance(env: ClusterEnv, argv: list[str]) -> None:
         low, high = nodes[0], nodes[-1]
         if high.shard_count() - low.shard_count() <= 1:
             break
-        # Move one shard the low node doesn't already hold for that vid.
+        # Move one shard the low node doesn't already hold for that
+        # vid — PREFERRING one whose move improves rack spread (the
+        # low node's rack holds fewer shards of that volume than the
+        # high node's rack). Count balance still wins when no such
+        # candidate exists: the fallback may move within a rack.
+        def rack_count(vid: int, dc: str, rack: str) -> int:
+            return sum(len(n.shards.get(vid, [])) for n in nodes
+                       if (n.data_center, n.rack) == (dc, rack))
+
         pick: Optional[tuple[int, int]] = None
+        fallback: Optional[tuple[int, int]] = None
         for vid, sids in high.shards.items():
             if (args.collection
                     and high.collections.get(vid, "") != args.collection):
                 continue
-            for sid in sids:
-                if sid not in low.shards.get(vid, []):
-                    pick = (vid, sid)
-                    break
-            if pick:
+            movable = [sid for sid in sids
+                       if sid not in low.shards.get(vid, [])]
+            if not movable:
+                continue
+            if fallback is None:
+                fallback = (vid, movable[0])
+            # both counts depend only on vid — one scan pair per vid
+            if rack_count(vid, low.data_center, low.rack) < \
+                    rack_count(vid, high.data_center, high.rack):
+                pick = (vid, movable[0])
                 break
+        if pick is None:
+            pick = fallback
         if pick is None:
             break
         vid, sid = pick

@@ -607,3 +607,51 @@ def test_heartbeat_self_heals_vanished_shard_file(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_ec_balance_prefers_rack_spread(cluster):
+    """ec.balance moves shards toward emptier nodes WITHOUT collapsing
+    rack diversity: among movable shards it prefers ones whose target
+    rack holds fewer shards of that volume."""
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        rng = np.random.default_rng(29)
+        blobs = [rng.integers(0, 256, 1200, dtype=np.uint8).tobytes()
+                 for _ in range(8)]
+        fids = operation.submit(mc, blobs)
+        vid = int(fids[0].split(",")[0])
+        env, out = _env(master)
+        run_cluster_command(env, f"ec.encode -volumeId {vid}")
+        _settle(servers)
+        run_cluster_command(env, "ec.balance")
+        _settle(servers)
+        # all 14 shards still mounted somewhere, each on exactly one
+        # node (a regressed source-delete would leave doubles)
+        locs = master.topology.lookup_ec_volume(vid)
+        assert sorted(locs) == list(range(14))
+        assert all(len(dns) == 1 for dns in locs.values()), locs
+        counts = sorted(
+            sum(len(m.shard_ids)
+                for (c, v), m in vs.store.ec_mounts.items() if v == vid)
+            for vs in servers)
+        assert counts[-1] - counts[0] <= 1  # balanced
+        # rack spread: the fixture's racks (r0: 2 nodes, r1: 1) can
+        # hold 14 shards at best 9/5 or 10/4 split; the preference
+        # must keep BOTH racks populated rather than draining one
+        by_rack = {}
+        for vs in servers:
+            n = sum(len(m.shard_ids)
+                    for (c, v), m in vs.store.ec_mounts.items()
+                    if v == vid)
+            by_rack[vs.rack] = by_rack.get(vs.rack, 0) + n
+        assert all(c > 0 for c in by_rack.values()), by_rack
+        # reads survive the moves
+        mc.invalidate()
+        keep = [(f, b) for f, b in zip(fids, blobs)
+                if int(f.split(",")[0]) == vid]
+        for f, b in keep:
+            assert operation.download(mc, f) == b
+        env.close()
+    finally:
+        mc.close()
