@@ -157,6 +157,24 @@ def _run_webdav(argv: list[str]) -> int:
     return main(argv)
 
 
+def _run_version(argv: list[str]) -> int:
+    import platform
+
+    import jax
+
+    from . import __version__
+    backends = []
+    try:
+        backends = [d.platform for d in jax.devices()]
+    except Exception:  # noqa: BLE001 — no accelerator attached
+        pass
+    print(f"seaweedfs-tpu {__version__} "
+          f"(python {platform.python_version()}, jax {jax.__version__}"
+          + (f", devices {sorted(set(backends))}" if backends else "")
+          + ")")
+    return 0
+
+
 COMMANDS = {
     "shell": _run_shell,
     "master": _run_master,
@@ -182,6 +200,7 @@ COMMANDS = {
     "scaffold": _run_scaffold,
     "tls.gen": _run_tls_gen,
     "cluster": _run_cluster,
+    "version": _run_version,
 }
 
 

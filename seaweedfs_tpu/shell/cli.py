@@ -4,7 +4,9 @@
 commands drive a live cluster over gRPC (the reference's only mode);
 with ``-dir`` they operate directly on local disk locations (an offline
 repair mode the reference covers with `weed fix`/`weed export`
-style commands). ``-c`` runs one command and exits:
+style commands). ``-c`` runs a command — or a ``;``-separated sequence
+sharing one session, so a held ``lock`` covers the later commands —
+and exits:
 
     python -m seaweedfs_tpu shell -master 127.0.0.1:9333
     python -m seaweedfs_tpu shell -dir /data -c "ec.encode -volumeId 3"
@@ -52,7 +54,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="security.toml with the cluster signing key")
     p.add_argument("-maxVolumes", type=int, default=8)
     p.add_argument("-c", dest="oneshot", default=None,
-                   help="run one command and exit")
+                   help="run command(s) and exit; ';' separates a "
+                        "sequence sharing one session (quoted "
+                        "arguments must not contain ';')")
     args = p.parse_args(argv)
     if bool(args.dir) == bool(args.master):
         print("error: exactly one of -dir / -master is required",
@@ -76,11 +80,25 @@ def main(argv: list[str] | None = None) -> int:
         cleanup = env.store.close
     try:
         if args.oneshot is not None:
-            try:
-                run(env, args.oneshot)
-            except ShellError as e:
-                print(f"error: {e}", file=sys.stderr)
-                return 1
+            # ';'-separated command sequences run in ONE session, so a
+            # REPL lock held by the first command covers the rest:
+            #   -c "lock; volume.balance; unlock"
+            for line in args.oneshot.split(";"):
+                if not line.strip():
+                    continue
+                try:
+                    run(env, line.strip())
+                except ShellError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 1
+                except ValueError as e:
+                    # shlex on a fragment of a quoted-';' argument:
+                    # a clean error, not a traceback
+                    print(f"error: cannot parse {line.strip()!r} "
+                          f"({e}); note ';' inside quotes is not "
+                          f"supported in -c sequences",
+                          file=sys.stderr)
+                    return 1
             return 0
         return _repl(run, env)
     finally:

@@ -48,3 +48,11 @@ def test_scaffold_security_mentions_tls():
     r = _run("scaffold", "-config", "security")
     assert r.returncode == 0
     assert "[grpc.tls]" in r.stdout
+
+
+def test_version_command(capsys):
+    from seaweedfs_tpu.__main__ import main
+
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "seaweedfs-tpu" in out and "jax" in out

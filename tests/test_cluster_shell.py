@@ -655,3 +655,19 @@ def test_ec_balance_prefers_rack_spread(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_shell_oneshot_semicolon_sequence(cluster):
+    """-c 'lock; cmd; unlock' runs in one session, so the held lock
+    covers the middle command."""
+    from seaweedfs_tpu.shell.cli import main as shell_main
+
+    master, _ = cluster
+    rc = shell_main(["-master", master.url,
+                     "-c", "lock; volume.balance; unlock"])
+    assert rc == 0
+    # lease released at the end: another shell can lock immediately
+    env, out = _env(master)
+    run_cluster_command(env, "lock")
+    assert "locked" in out.getvalue()
+    env.close()
