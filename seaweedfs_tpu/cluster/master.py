@@ -655,10 +655,15 @@ def _make_http_handler(ms: MasterServer):
                         self._json({"volumeId": str(vid),
                                     "locations": locs})
                 elif u.path in ("/cluster/status", "/dir/status"):
+                    with ms._admin_mu:
+                        lock_holder = (ms._admin_holder
+                                       if ms._admin_expires > time.time()
+                                       else "")
                     self._json({"IsLeader": ms.is_leader,
                                 "Leader": ms.leader_url or ms.url,
                                 "Peers": ms.ha.peers,
                                 "Term": ms.ha.term,
+                                "AdminLockHolder": lock_holder,
                                 "Topology": ms.topology.to_map()})
                 elif u.path == "/metrics":
                     body = ms.metrics.render().encode()

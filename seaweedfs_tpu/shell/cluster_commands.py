@@ -140,16 +140,20 @@ class ClusterEnv:
             return [l.url for l in e.locations]
         return []
 
-    # -- exclusive admin lease (shell lock/unlock) --
+    # -- master HTTP plumbing --
 
-    def _admin_call(self, verb: str) -> dict:
+    def _master_http(self, path_q: str, method: str = "GET",
+                     host: str = "") -> dict:
+        """One JSON request against a master's HTTP plane with the
+        error mapping every caller needs (HTTPError body -> message,
+        connection failure -> ShellError naming the master)."""
         import json as json_mod
         import urllib.error
         import urllib.request
 
-        url = (f"http://{self.master_url}/admin/{verb}"
-               f"?client={self._lock_client}")
-        req = urllib.request.Request(url, method="POST")
+        host = host or self.master_url
+        req = urllib.request.Request(f"http://{host}{path_q}",
+                                     method=method)
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 return json_mod.loads(resp.read() or b"{}")
@@ -163,7 +167,13 @@ class ClusterEnv:
             # connection-level failure must surface as the same error
             # type or close()/finally cleanup paths leak past it
             raise ShellError(
-                f"master {self.master_url} unreachable: {e}") from None
+                f"master {host} unreachable: {e}") from None
+
+    # -- exclusive admin lease (shell lock/unlock) --
+
+    def _admin_call(self, verb: str) -> dict:
+        return self._master_http(
+            f"/admin/{verb}?client={self._lock_client}", method="POST")
 
     def _start_renewer(self, lease: float) -> None:
         """Renew at a third of the lease period; a failed renew
@@ -1524,6 +1534,21 @@ def cmd_cluster_status(env: ClusterEnv, argv: list[str]) -> None:
     env.println(f"master {env.master_url} "
                 f"volumeSizeLimit={resp.volume_size_limit} "
                 f"jwt={'on' if resp.jwt_enabled else 'off'}")
+    try:
+        doc = env._master_http("/cluster/status")
+        # the admin lease lives on the LEADER; a follower's local view
+        # is always empty — follow the Leader field before concluding
+        # the cluster is unlocked
+        if not doc.get("AdminLockHolder") and \
+                doc.get("Leader") and \
+                doc.get("Leader") != env.master_url:
+            doc = env._master_http("/cluster/status",
+                                   host=doc["Leader"])
+        holder = doc.get("AdminLockHolder", "")
+        if holder:
+            env.println(f"admin lock held by {holder}")
+    except ShellError:
+        pass  # status stays best-effort
     nodes = env.collect_ec_nodes()
     env.println(f"{len(nodes)} data nodes")
 

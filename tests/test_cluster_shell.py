@@ -434,6 +434,9 @@ def test_shell_admin_lock(cluster):
     try:
         run_cluster_command(env1, "lock")
         assert "locked" in out1.getvalue()
+        # the holder is visible to everyone via cluster.status
+        run_cluster_command(env2, "cluster.status")
+        assert "admin lock held by" in out2.getvalue()
         # another shell cannot lock or run destructive commands
         with pytest.raises(Exception, match="locked by"):
             run_cluster_command(env2, "lock")
