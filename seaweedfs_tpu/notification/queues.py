@@ -29,7 +29,10 @@ def event_to_dict(ev) -> dict:
 
     return {"tsNs": ev.ts_ns, "directory": ev.directory,
             "oldEntry": entry(ev.old_entry),
-            "newEntry": entry(ev.new_entry)}
+            "newEntry": entry(ev.new_entry),
+            # origin chain (filer.sync loop prevention): lets external
+            # consumers distinguish local writes from replicated ones
+            "signatures": list(ev.signatures)}
 
 
 class MessageQueue:
