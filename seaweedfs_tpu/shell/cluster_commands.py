@@ -791,16 +791,26 @@ def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
     """Move whole volumes from loaded to free servers
     (command_volume_balance.go, via VolumeCopy + delete)."""
     p = _parser("volume.balance")
-    p.parse_args(argv)
+    p.add_argument("-collection", default="",
+                   help="only move volumes of this collection")
+    args = p.parse_args(argv)
     moved = 0
     for _round in range(100):
         resp = env.volume_list()
+        # With -collection, BOTH node selection and the termination
+        # check run on collection-scoped counts: selecting by total
+        # count could pick a "high" node holding none of the target
+        # collection and stop with it still concentrated elsewhere.
         counts: list[tuple[int, str, list]] = []
         for dc in resp.topology_info.data_center_infos:
             for rack in dc.rack_infos:
                 for dn in rack.data_node_infos:
-                    counts.append((dn.volume_count, dn.id,
-                                   list(dn.volume_infos)))
+                    vols = [v for v in dn.volume_infos
+                            if not args.collection
+                            or v.collection == args.collection]
+                    n = len(vols) if args.collection \
+                        else dn.volume_count
+                    counts.append((n, dn.id, vols))
         if len(counts) < 2:
             break
         counts.sort()

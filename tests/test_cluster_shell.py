@@ -674,3 +674,48 @@ def test_shell_oneshot_semicolon_sequence(cluster):
     run_cluster_command(env, "lock")
     assert "locked" in out.getvalue()
     env.close()
+
+
+def test_shell_volume_balance_collection_filter(cluster):
+    """-collection scopes balancing BOTH ways: the named collection
+    gets evened out (node selection runs on scoped counts) and other
+    collections' volumes never move."""
+    master, servers = cluster
+    for _ in range(4):
+        master.grow_volume(collection="keepme")
+    _settle(servers)
+    env, out = _env(master)
+
+    def keepme_placement():
+        return {vs.url: sorted(v for (c, v) in vs.store.volumes
+                               if c == "keepme") for vs in servers}
+
+    # concentrate every keepme volume on one node
+    target = servers[0].url
+    for url, vids in keepme_placement().items():
+        for vid in vids:
+            if url != target:
+                run_cluster_command(
+                    env, f"volume.move -volumeId {vid} -collection "
+                         f"keepme -source {url} -target {target}")
+    _settle(servers)
+    assert len(keepme_placement()[target]) == 4
+
+    other = {vs.url: sorted(v for (c, v) in vs.store.volumes
+                            if c != "keepme") for vs in servers}
+    # a filtered balance for ANOTHER collection moves nothing
+    run_cluster_command(env,
+                        "volume.balance -collection somethingelse")
+    _settle(servers)
+    assert len(keepme_placement()[target]) == 4
+
+    # the positive path: scoped balance spreads keepme within one
+    run_cluster_command(env, "volume.balance -collection keepme")
+    _settle(servers)
+    scoped = sorted(len(v) for v in keepme_placement().values())
+    assert scoped[-1] - scoped[0] <= 1, keepme_placement()
+    # and non-keepme placement never changed
+    assert other == {vs.url: sorted(v for (c, v) in vs.store.volumes
+                                    if c != "keepme")
+                     for vs in servers}
+    env.close()
