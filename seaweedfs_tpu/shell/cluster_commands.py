@@ -495,14 +495,26 @@ def cmd_ec_balance(env: ClusterEnv, argv: list[str]) -> None:
     p = _parser("ec.balance")
     p.add_argument("-collection", default="")
     args = p.parse_args(argv)
+
+    def scoped_count(n: EcNode) -> int:
+        """Shards that the -collection filter makes movable on this
+        node — selection and termination must use the SAME scope as
+        the move picker, or a filtered balance can pick a high node
+        holding nothing movable and stop early (the volume.balance
+        -collection fix, applied here symmetrically)."""
+        if not args.collection:
+            return n.shard_count()
+        return sum(len(s) for vid, s in n.shards.items()
+                   if n.collections.get(vid, "") == args.collection)
+
     moved = 0
     for _round in range(100):
         nodes = env.collect_ec_nodes()
         if len(nodes) < 2:
             break
-        nodes.sort(key=lambda n: n.shard_count())
+        nodes.sort(key=scoped_count)
         low, high = nodes[0], nodes[-1]
-        if high.shard_count() - low.shard_count() <= 1:
+        if scoped_count(high) - scoped_count(low) <= 1:
             break
         # Move one shard the low node doesn't already hold for that
         # vid — PREFERRING one whose move improves rack spread (the
@@ -808,9 +820,11 @@ def cmd_volume_balance(env: ClusterEnv, argv: list[str]) -> None:
                     vols = [v for v in dn.volume_infos
                             if not args.collection
                             or v.collection == args.collection]
-                    n = len(vols) if args.collection \
-                        else dn.volume_count
-                    counts.append((n, dn.id, vols))
+                    # len(vols) serves both paths: sorting on the
+                    # heartbeat's separate volume_count field while
+                    # picking moves from volume_infos would leave two
+                    # sources to disagree under lag
+                    counts.append((len(vols), dn.id, vols))
         if len(counts) < 2:
             break
         counts.sort()

