@@ -860,10 +860,12 @@ def cmd_volume_fix_replication(env: ClusterEnv, argv: list[str]) -> None:
     # vid -> (collection, rp, holders)
     vols: dict[int, tuple[str, int, list[str]]] = {}
     all_nodes: list[str] = []
+    racks: dict[str, tuple[str, str]] = {}
     for dc in resp.topology_info.data_center_infos:
         for rack in dc.rack_infos:
             for dn in rack.data_node_infos:
                 all_nodes.append(dn.id)
+                racks[dn.id] = (dc.id, rack.id)
                 for v in dn.volume_infos:
                     col, rp, holders = vols.get(
                         v.id, (v.collection, v.replica_placement, []))
@@ -874,14 +876,30 @@ def cmd_volume_fix_replication(env: ClusterEnv, argv: list[str]) -> None:
         want = ReplicaPlacement.from_byte(rp_byte).copy_count()
         if len(holders) >= want:
             continue
-        spare = [u for u in all_nodes if u not in holders]
-        for target in spare[:want - len(holders)]:
+        # placement-aware, chosen GREEDILY per missing replica: the
+        # held-racks set grows after every copy, so two replacements
+        # never pile into the same fresh rack while another rack sits
+        # empty (a rack-diverse placement exists to survive rack loss)
+        for _ in range(want - len(holders)):
+            held_racks = {racks[h] for h in holders}
+            spare = sorted(
+                (u for u in all_nodes if u not in holders),
+                key=lambda u: racks[u] in held_racks)
+            if not spare:
+                break
+            target = spare[0]
             env.volume(target).VolumeCopy(
                 volume_server_pb2.VolumeCopyRequest(
                     volume_id=vid, collection=col,
                     source_data_node=holders[0]))
+            if racks[target] in held_racks:
+                env.println(
+                    f"volume.fix.replication: WARNING volume {vid} "
+                    f"replica lands on rack {racks[target][1]} which "
+                    f"already holds one (no rack-free node available)")
             env.println(f"volume.fix.replication: volume {vid} "
                         f"copied {holders[0]} -> {target}")
+            holders.append(target)
             fixed += 1
     if not fixed:
         env.println("volume.fix.replication: all volumes fully "
@@ -1506,12 +1524,14 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
     p.parse_args(argv)
     resp = env.volume_list()
     vols: dict[int, tuple[str, int, list[str]]] = {}
+    node_racks: dict[str, tuple[str, str]] = {}
     full_nodes = 0
     n_nodes = 0
     for dc in resp.topology_info.data_center_infos:
         for rack in dc.rack_infos:
             for dn in rack.data_node_infos:
                 n_nodes += 1
+                node_racks[dn.id] = (dc.id, rack.id)
                 if dn.max_volume_count and \
                         dn.volume_count >= dn.max_volume_count:
                     full_nodes += 1
@@ -1525,11 +1545,38 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
                     vols[v.id] = (col, rp, holders)
     problems = full_nodes
     for vid, (col, rp_byte, holders) in sorted(vols.items()):
-        want = ReplicaPlacement.from_byte(rp_byte).copy_count()
+        rp = ReplicaPlacement.from_byte(rp_byte)
+        want = rp.copy_count()
         if len(holders) < want:
             env.println(f"volume {vid} under-replicated: "
                         f"{len(holders)}/{want} replicas")
             problems += 1
+        elif len(holders) > 1:
+            # placement CONFORMANCE, not just count. Two axes, judged
+            # by the placement's own semantics: diff_dc wants distinct
+            # DCs; diff_rack wants distinct racks WITHIN a DC (a
+            # replica in another DC must not mask two same-DC replicas
+            # sharing one rack).
+            violated = ""
+            if rp.diff_dc:
+                dcs = {node_racks.get(h, ("?", "?"))[0]
+                       for h in holders}
+                if len(dcs) < min(len(holders), 1 + rp.diff_dc):
+                    violated = (f"{len(holders)} replicas in "
+                                f"{len(dcs)} DC(s)")
+            if not violated and rp.diff_rack:
+                by_dc: dict[str, list[str]] = {}
+                for h in holders:
+                    d, r = node_racks.get(h, ("?", "?"))
+                    by_dc.setdefault(d, []).append(r)
+                d, rs = max(by_dc.items(), key=lambda kv: len(kv[1]))
+                if len(set(rs)) < min(len(rs), 1 + rp.diff_rack):
+                    violated = (f"{len(rs)} replicas in DC {d} share "
+                                f"{len(set(rs))} rack(s)")
+            if violated:
+                env.println(f"volume {vid} placement violation: "
+                            f"{violated} for placement {rp}")
+                problems += 1
     # EC: shard ids present anywhere per volume; a gap below the max id
     # is definitely a missing shard (totals need the .vif, so only
     # provable gaps are reported — ec.rebuild is authoritative).

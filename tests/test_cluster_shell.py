@@ -741,22 +741,72 @@ def test_shell_ec_balance_collection_scoped_selection(cluster):
                             f"ec.encode -volumeId {vid} -collection ecb")
         _settle(servers)
 
-        def scoped(vs):
+        # a SECOND collection whose shards dominate total counts:
+        # with the old total-count selection, the scoped balance
+        # would pick nodes by these and stall
+        b = operation.assign(mc, collection="heavy")
+        operation.upload(b.url, b.fid,
+                         rng.integers(0, 256, 1500,
+                                      dtype=np.uint8).tobytes(),
+                         jwt=b.auth, collection="heavy")
+        vid2 = int(b.fid.split(",")[0])
+        _settle(servers)
+        run_cluster_command(
+            env, f"ec.encode -volumeId {vid2} -collection heavy")
+        _settle(servers)
+
+        def scoped(vs, col="ecb"):
             return sum(len(m.shard_ids)
                        for (c, v), m in vs.store.ec_mounts.items()
-                       if c == "ecb")
+                       if c == col)
 
-        # concentrate: move every ecb shard onto servers[0] by
-        # unbalancing through direct copy+delete choreography
+        heavy_before = {vs.url: scoped(vs, "heavy") for vs in servers}
         run_cluster_command(env, "ec.balance -collection ecb")
         _settle(servers)
         counts = sorted(scoped(vs) for vs in servers)
         assert counts[-1] - counts[0] <= 1, counts
         assert sum(counts) == 14
+        # the other collection's shards never moved
+        assert heavy_before == {vs.url: scoped(vs, "heavy")
+                                for vs in servers}
         # data still readable
         mc.invalidate()
         assert operation.download(
             mc, a.fid, collection="ecb") is not None
+        env.close()
+    finally:
+        mc.close()
+
+
+def test_fix_replication_prefers_rack_diversity_and_check_flags(cluster):
+    """fix.replication targets a rack without a replica first, and
+    cluster.check reports placement violations (replicas sharing a
+    rack under a rack-diverse placement)."""
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        a = operation.assign(mc, collection="rr", replication="010")
+        operation.upload(a.url, a.fid, b"rack-me", collection="rr")
+        vid = int(a.fid.split(",")[0])
+        _settle(servers)
+        holders = [vs for vs in servers
+                   if vs.store.has_volume(vid, "rr")]
+        assert len(holders) == 2
+        # delete one replica; re-replication must land in the OTHER
+        # rack (fixture racks: r0 x2 nodes, r1 x1)
+        holders[1].store.delete_volume(vid, "rr")
+        _settle(servers)
+        env, out = _env(master)
+        run_cluster_command(env, "volume.fix.replication")
+        _settle(servers)
+        new_holders = [vs for vs in servers
+                       if vs.store.has_volume(vid, "rr")]
+        assert len(new_holders) == 2
+        assert {vs.rack for vs in new_holders} == {"r0", "r1"}, \
+            [(vs.url, vs.rack) for vs in new_holders]
+        # healthy placement: no violation reported
+        run_cluster_command(env, "cluster.check")
+        assert "placement violation" not in out.getvalue()
         env.close()
     finally:
         mc.close()
