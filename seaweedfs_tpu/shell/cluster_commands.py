@@ -289,6 +289,36 @@ def _lock_client_name() -> str:
     return f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
 
 
+def _http_delete_needle(env: "ClusterEnv", url: str, vid: int,
+                        col: str, key: int) -> None:
+    """Tombstone one needle via a server's HTTP DELETE (which fans out
+    to its replica peers): cookie recovered over ReadNeedleBlob, write
+    JWT minted from the shell secret. Shared by fsck -purge and
+    check.disk -resolveDeletes so the auth/URL shape lives once.
+    Raises on failure — note the contacted server may have applied
+    the tombstone even when its replica fan-out then failed."""
+    import urllib.request
+
+    from ..pb import volume_server_pb2 as vpb
+    from ..storage import needle as needle_mod
+    from ..storage.types import FileId
+    from ..util import security
+
+    blob = env.volume(url).ReadNeedleBlob(
+        vpb.ReadNeedleBlobRequest(volume_id=vid, collection=col,
+                                  needle_id=key))
+    cookie = needle_mod.parse_header(blob.needle_blob)[0]
+    fid = str(FileId(volume_id=vid, key=key, cookie=cookie))
+    req = urllib.request.Request(
+        f"http://{url}/{fid}" + (f"?collection={col}" if col else ""),
+        method="DELETE")
+    guard = security.Guard(env.secret)
+    if guard.enabled:
+        req.add_header("Authorization", f"BEARER {guard.sign(fid)}")
+    with urllib.request.urlopen(req, timeout=60):
+        pass
+
+
 CLUSTER_COMMANDS: dict[str, Callable[[ClusterEnv, list[str]], None]] = {}
 
 #: Commands that mutate cluster state and therefore run under the
@@ -1122,8 +1152,10 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
     sync divergence (command_volume_check_disk.go): stream every
     replica's .idx, diff the live sets, and with -fix copy missing
     needles raw (ReadNeedleBlob -> WriteNeedleBlob) so CRCs and
-    timestamps survive bit-for-bit. Needles tombstoned on one replica
-    are never resurrected onto it."""
+    timestamps survive bit-for-bit. Size-skewed needles are reported,
+    never auto-resolved; tombstone skews are reported by default (a
+    needle is never resurrected) and the delete is finished everywhere
+    under the explicit -resolveDeletes opt-in."""
     from ..storage import idx as idx_mod
     from ..storage.types import TOMBSTONE_FILE_SIZE
 
@@ -1132,6 +1164,11 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
     p.add_argument("-collection", default="")
     p.add_argument("-fix", action="store_true",
                    help="sync missing needles (default: report only)")
+    p.add_argument("-resolveDeletes", action="store_true",
+                   help="propagate deletes: a needle tombstoned on "
+                        "any replica is deleted everywhere (explicit "
+                        "opt-in — this finishes a client's delete, "
+                        "it can't be undone)")
     args = p.parse_args(argv)
     resp = env.volume_list()
     # (collection, vid) -> [holder urls]
@@ -1166,7 +1203,7 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
                 dead.discard(e.key)
         return live, dead
 
-    checked = synced = divergent = skews = 0
+    checked = synced = divergent = skews = deletes_propagated = 0
     for (col, vid), urls in sorted(replicas.items(),
                                    key=lambda kv: kv[0][1]):
         if len(urls) < 2:
@@ -1183,17 +1220,34 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
         for d in deads.values():
             all_dead.update(d)
         # A needle live on one replica but tombstoned on another is
-        # reported, never auto-resolved: resurrecting would undo a
-        # client's delete, deleting would need the client's cookie
-        # semantics — the operator decides (reference check.disk skips
-        # these the same way).
+        # reported; it is only MUTATED under the explicit
+        # -resolveDeletes opt-in (finish the client's delete
+        # everywhere) — resurrecting is never an option, and the
+        # default remains report-only like the reference check.disk.
         for k in sorted(union & all_dead):
             holders_live = [u for u in urls if k in maps[u]]
             if holders_live:
                 skews += 1
                 env.println(
                     f"volume {vid} needle {k}: live on "
-                    f"{', '.join(holders_live)} but deleted elsewhere")
+                    f"{', '.join(holders_live)} but deleted elsewhere"
+                    + (" — propagating the delete"
+                       if args.resolveDeletes else ""))
+                if not args.resolveDeletes:
+                    continue
+                url = holders_live[0]
+                try:
+                    # the server fans the delete out to its replica
+                    # peers, so one request tombstones every live copy
+                    _http_delete_needle(env, url, vid, col, k)
+                    deletes_propagated += 1
+                    skews -= 1  # resolved, no longer outstanding
+                except Exception as e:  # noqa: BLE001 — keep sweeping
+                    env.println(
+                        f"  delete propagation of needle {k} via "
+                        f"{url} errored ({e}); the tombstone may have "
+                        f"landed there even if replica fan-out "
+                        f"failed — re-run to re-check")
         # Same key live with different sizes = a missed overwrite; the
         # idx alone cannot say which side is newer, so report it and
         # keep it OUT of the sync loop below (copying an arbitrary
@@ -1235,7 +1289,10 @@ def cmd_volume_check_disk(env: ClusterEnv, argv: list[str]) -> None:
                 synced += 1
     env.println(f"volume.check.disk: {checked} replicated volumes "
                 f"checked, {divergent} divergent replicas, "
-                f"{synced} needles synced, {skews} unresolved skews")
+                f"{synced} needles synced, "
+                + (f"{deletes_propagated} deletes propagated, "
+                   if deletes_propagated else "")
+                + f"{skews} unresolved skews")
 
 
 @cluster_command("volume.unmount")

@@ -422,6 +422,14 @@ def test_shell_volume_check_disk(cluster):
         run_cluster_command(env, "volume.check.disk -collection cd")
         assert "0 divergent" in out.getvalue()
         assert "1 unresolved skews" in out.getvalue()
+        # explicit opt-in propagates the delete everywhere: the needle
+        # still live on A gets tombstoned, skew disappears
+        run_cluster_command(
+            env, "volume.check.disk -collection cd -resolveDeletes")
+        assert va.nm.get(dead_id) is None
+        out.truncate(0)
+        run_cluster_command(env, "volume.check.disk -collection cd")
+        assert "0 unresolved skews" in out.getvalue()
         env.close()
     finally:
         mc.close()
