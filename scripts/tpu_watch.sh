@@ -76,5 +76,8 @@ while true; do
       echo "$TS non-degraded TPU result recorded (grouped dispatch not yet validated)" >> "$LOG"
     fi
   fi
-  sleep 180
+  # 60s between probes (probe timeout is 90s, so worst-case cycle
+  # ~2.5 min): windows can be short and a late-round one is the last
+  # chance to validate the grouped dispatch on hardware
+  sleep 60
 done
