@@ -1,0 +1,24 @@
+#!/bin/bash
+# Hunt flakes in the chunk-cache concurrency tests: 10x the full cache
+# suite (thread stress, disk-tier crash reload, invalidation hooks).
+# Any non-pass line lands in artifacts/cache_stress.log with a
+# timestamp; a clean hunt ends with "done all-passed".
+cd /root/repo || exit 1
+mkdir -p artifacts
+fails=0
+for i in $(seq 1 10); do
+  out=$(JAX_PLATFORMS=cpu timeout 300 python -m pytest \
+        tests/test_chunk_cache.py tests/test_cache_invalidation.py \
+        -q -p no:cacheprovider 2>&1 | tail -3)
+  line=$(echo "$out" | grep -E "FAILED|ERROR|passed|failed" | tail -2)
+  echo "$(date +%s) run$i: $line" >> artifacts/cache_stress.log
+  if echo "$out" | grep -qE "FAILED|ERROR"; then
+    fails=$((fails + 1))
+  fi
+done
+if [ "$fails" -eq 0 ]; then
+  echo "$(date +%s) done all-passed" >> artifacts/cache_stress.log
+else
+  echo "$(date +%s) done $fails/10 runs had failures" >> artifacts/cache_stress.log
+fi
+exit "$fails"

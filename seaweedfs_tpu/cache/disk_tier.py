@@ -1,0 +1,217 @@
+"""On-disk cache tier: append-only needle-layer files + in-memory index.
+
+Mirrors weed/util/chunk_cache's on_disk_cache_layer: a fixed ring of
+``cache_<i>.dat`` segment files, each an append-only log of
+``[header][key][payload]`` records, with the key -> (segment, offset,
+size) map held only in memory. Filling the active segment rotates to the
+next slot, truncating whatever generation lived there — eviction is
+whole-segment, so the tier needs no per-record free-space bookkeeping.
+
+Crash restart: the index is rebuilt by scanning every segment file
+(oldest mtime first, so the newest record for a key wins) and the active
+segment's torn tail — a record cut mid-write — is truncated away.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+#: magic(1) flags(1) key_len(2) volume(4) data_len(4) expires_epoch(8)
+_HEADER = struct.Struct(">BBHId")
+_MAGIC = 0xC5
+#: One record may not claim more than this fraction of a segment, or a
+#: single giant put would wipe a whole generation for one entry.
+_MAX_RECORD_FRACTION = 0.5
+
+
+class _IndexEntry:
+    __slots__ = ("segment", "offset", "size", "volume", "expires")
+
+    def __init__(self, segment: int, offset: int, size: int,
+                 volume: Optional[int], expires: float):
+        self.segment = segment
+        self.offset = offset
+        self.size = size
+        self.volume = volume
+        self.expires = expires
+
+
+class DiskTier:
+    """Thread-safe; callers may also hold their own lock above it."""
+
+    def __init__(self, directory: str | Path,
+                 capacity_bytes: int = 256 * 1024 * 1024,
+                 segments: int = 4, clock=time.time):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.segments = max(2, int(segments))
+        self.segment_cap = max(4096, int(capacity_bytes) // self.segments)
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._index: dict[str, _IndexEntry] = {}
+        self._sizes = [0] * self.segments
+        self._fh: list = [None] * self.segments
+        self._active = 0
+        self.evictions = 0
+        self._load()
+
+    # ------------- segment files -------------
+
+    def _seg_path(self, i: int) -> Path:
+        return self.dir / f"cache_{i}.dat"
+
+    def _file(self, i: int):
+        if self._fh[i] is None:
+            p = self._seg_path(i)
+            p.touch(exist_ok=True)
+            self._fh[i] = open(p, "r+b")
+        return self._fh[i]
+
+    def close(self) -> None:
+        with self._lock:
+            for i, f in enumerate(self._fh):
+                if f is not None:
+                    f.close()
+                    self._fh[i] = None
+
+    # ------------- load / scan -------------
+
+    def _load(self) -> None:
+        present = [(self._seg_path(i).stat().st_mtime, i)
+                   for i in range(self.segments)
+                   if self._seg_path(i).exists()]
+        # Oldest first: a key rewritten in a newer generation overwrites
+        # its stale index entry during the replay.
+        for _, i in sorted(present):
+            self._sizes[i] = self._scan_segment(i)
+        if present:
+            self._active = sorted(present)[-1][1]
+
+    def _scan_segment(self, i: int) -> int:
+        """Replay one segment into the index; returns the byte length of
+        the valid prefix (a torn tail is truncated off)."""
+        f = self._file(i)
+        f.seek(0, 2)
+        end = f.tell()
+        f.seek(0)
+        pos = 0
+        while pos + _HEADER.size <= end:
+            hdr = f.read(_HEADER.size)
+            if len(hdr) < _HEADER.size:
+                break
+            magic, _flags, key_len, vol, expires = _HEADER.unpack(hdr)
+            if magic != _MAGIC:
+                break
+            size_raw = f.read(4)
+            if len(size_raw) < 4:
+                break
+            size = int.from_bytes(size_raw, "big")
+            if pos + _HEADER.size + 4 + key_len + size > end:
+                break  # torn tail
+            key = f.read(key_len).decode("utf-8", "replace")
+            data_off = f.tell()
+            f.seek(size, 1)
+            self._index[key] = _IndexEntry(
+                i, data_off, size, vol or None, expires)
+            pos = data_off + size
+        if pos < end:
+            f.truncate(pos)
+        return pos
+
+    # ------------- api -------------
+
+    def admit(self, size: int) -> bool:
+        return size <= int(self.segment_cap * _MAX_RECORD_FRACTION)
+
+    def put(self, key: str, data: bytes, volume: Optional[int] = None,
+            expires: float = 0.0) -> int:
+        """Append one record; returns how many entries rotation evicted."""
+        kb = key.encode("utf-8")
+        rec_len = _HEADER.size + 4 + len(kb) + len(data)
+        if not self.admit(len(data)):
+            return 0
+        evicted = 0
+        with self._lock:
+            if self._sizes[self._active] + rec_len > self.segment_cap:
+                evicted = self._rotate()
+            i = self._active
+            f = self._file(i)
+            f.seek(self._sizes[i])
+            f.write(_HEADER.pack(_MAGIC, 0, len(kb), volume or 0,
+                                 float(expires)))
+            f.write(len(data).to_bytes(4, "big"))
+            f.write(kb)
+            data_off = self._sizes[i] + _HEADER.size + 4 + len(kb)
+            f.write(data)
+            f.flush()
+            self._sizes[i] += rec_len
+            self._index[key] = _IndexEntry(i, data_off, len(data),
+                                           volume, float(expires))
+        return evicted
+
+    def _rotate(self) -> int:
+        nxt = (self._active + 1) % self.segments
+        dead = [k for k, e in self._index.items() if e.segment == nxt]
+        for k in dead:
+            del self._index[k]
+        self.evictions += len(dead)
+        f = self._file(nxt)
+        f.truncate(0)
+        self._sizes[nxt] = 0
+        self._active = nxt
+        return len(dead)
+
+    def get(self, key: str
+            ) -> Optional[tuple[bytes, Optional[int], float]]:
+        """(payload, volume, expires) or None (missing/expired)."""
+        with self._lock:
+            e = self._index.get(key)
+            if e is None:
+                return None
+            if e.expires and self.clock() > e.expires:
+                del self._index[key]
+                return None
+            f = self._file(e.segment)
+            f.seek(e.offset)
+            data = f.read(e.size)
+            if len(data) != e.size:
+                del self._index[key]
+                return None
+            return data, e.volume, e.expires
+
+    def remove(self, key: str) -> bool:
+        """Drop from the index only; bytes are reclaimed at rotation."""
+        with self._lock:
+            return self._index.pop(key, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._index.clear()
+            for i in range(self.segments):
+                if self._seg_path(i).exists():
+                    self._file(i).truncate(0)
+                self._sizes[i] = 0
+            self._active = 0
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._index
+
+    def keys_with_volumes(self) -> Iterator[tuple[str, Optional[int]]]:
+        with self._lock:
+            items = [(k, e.volume) for k, e in self._index.items()]
+        return iter(items)
+
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return sum(e.size for e in self._index.values())

@@ -1,0 +1,56 @@
+"""Cache invalidation registry: storage mutators -> live caches.
+
+Vacuum compaction and EC shard rebuild change what a volume's bytes
+mean; any chunk cache still holding pre-mutation payloads for that
+volume must drop them before the next read. Mutators call
+``volume_invalidated`` / ``base_invalidated`` here; every live
+``ChunkCache`` registers itself at construction (weakly, so caches die
+with their owners) and gets ``invalidate_volume`` called.
+
+Over-invalidation is always safe — a dropped entry is just a future
+miss — so notifications carry only the volume id, never a collection.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import weakref
+from pathlib import Path
+
+_lock = threading.Lock()
+_caches: "weakref.WeakSet" = weakref.WeakSet()
+#: reason -> notification count, for cache.status / tests.
+events: dict[str, int] = {}
+
+_BASE_VID_RE = re.compile(r"(\d+)$")
+
+
+def register_cache(cache) -> None:
+    with _lock:
+        _caches.add(cache)
+
+
+def unregister_cache(cache) -> None:
+    with _lock:
+        _caches.discard(cache)
+
+
+def volume_invalidated(volume_id: int, reason: str = "") -> None:
+    with _lock:
+        events[reason or "unknown"] = events.get(reason or "unknown",
+                                                 0) + 1
+        targets = list(_caches)
+    for c in targets:
+        try:
+            c.invalidate_volume(volume_id)
+        except Exception:  # noqa: BLE001 — one dying cache must not
+            pass           # block the others from invalidating
+
+
+def base_invalidated(base, reason: str = "") -> None:
+    """Notify from a volume *base path* (``.../<collection>_<vid>`` or
+    ``.../<vid>``), the identity EC-layer code has in hand."""
+    m = _BASE_VID_RE.search(Path(base).name)
+    if m:
+        volume_invalidated(int(m.group(1)), reason=reason)

@@ -25,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from .. import pb
+from ..cache import ChunkCache
 from ..pb import master_pb2, volume_server_pb2
 from ..pipeline import decode as decode_mod
 from ..pipeline import encode as encode_mod
@@ -88,7 +89,8 @@ class VolumeServer:
                  port: int = 8080, master_url: str = "",
                  public_url: str = "", data_center: str = "",
                  rack: str = "", pulse_seconds: float = 5.0,
-                 secret: str = "", read_mode: str = "proxy"):
+                 secret: str = "", read_mode: str = "proxy",
+                 ec_cache_bytes: int = 64 * 1024 * 1024):
         self.store = store
         self.ip = ip
         self.port = port
@@ -104,6 +106,12 @@ class VolumeServer:
         self.pulse_seconds = pulse_seconds
         self.guard = security.Guard(secret)
         self.metrics = Metrics(namespace="volume_server")
+        #: Post-decode needle cache for cold-tier (EC) reads: a hot
+        #: needle on a sealed volume pays interval assembly / RS decode
+        #: once, not per request. Registered with cache/invalidation.py,
+        #: so vacuum and ec.rebuild drop the volume's entries.
+        self.chunk_cache = ChunkCache(ec_cache_bytes,
+                                      metrics=self.metrics)
         self.volume_size_limit = 30 * 1024 ** 3
         self._channels: dict[str, object] = {}
         self._grpc_server = None
@@ -165,6 +173,7 @@ class VolumeServer:
             if self._metrics_pusher is not None:
                 self._metrics_pusher.stop()
                 self._metrics_pusher = None
+        self.chunk_cache.close()
         self.store.close()
 
     def __enter__(self) -> "VolumeServer":
@@ -380,6 +389,12 @@ class VolumeServer:
 
     # ------------- data plane -------------
 
+    @staticmethod
+    def _ec_cache_key(volume_id: int, fid: FileId) -> str:
+        # vid+key+cookie is cluster-unique; the collection is left out
+        # on purpose — lookups with and without it must share the entry.
+        return f"ec:{volume_id}:{fid.key}:{fid.cookie}"
+
     def read_bytes(self, volume_id: int, fid: FileId,
                    collection: str = "") -> bytes:
         """GET path: normal volume first, then mounted EC shards."""
@@ -387,6 +402,10 @@ class VolumeServer:
             n = self.store.read_needle(volume_id, fid.key, fid.cookie,
                                        collection)
             return n.data
+        ckey = self._ec_cache_key(volume_id, fid)
+        cached = self.chunk_cache.get(ckey)
+        if cached is not None:
+            return cached
         mount = self.store.ec_mounts.get((collection, volume_id))
         if mount is None and collection == "":
             # Collection not known from the fid; match on vid alone.
@@ -401,6 +420,7 @@ class VolumeServer:
         n = reader.read_needle(fid.key, fid.cookie)
         self.metrics.counter("ec_intervals_repaired").inc(
             reader.intervals_repaired)
+        self.chunk_cache.put(ckey, n.data, volume=volume_id)
         return n.data
 
     def write_needle_local(self, volume_id: int, n: Needle,
@@ -1016,6 +1036,7 @@ def _make_http_handler(vs: VolumeServer):
                     return
                 ok = vs.store.delete_needle(vid, fid.key,
                                             q.get("collection", ""))
+                vs.chunk_cache.invalidate(vs._ec_cache_key(vid, fid))
                 if q.get("type") != "replicate":
                     for peer in vs.replica_peers(vid,
                                                  q.get("collection", "")):

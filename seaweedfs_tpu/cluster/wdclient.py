@@ -13,6 +13,7 @@ import time
 from typing import Optional
 
 from .. import pb
+from ..cache import METRICS as _cache_metrics
 from ..pb import master_pb2
 from .master import _grpc_port
 from ..util import tls as tls_mod
@@ -102,7 +103,9 @@ class MasterClient:
         with self._lock:
             hit = self._vid_map.get(volume_id)
             if hit and now - hit[0] < self.cache_seconds:
+                _cache_metrics.counter("cache_hits", tier="vidmap").inc()
                 return hit[1]
+        _cache_metrics.counter("cache_misses", tier="vidmap").inc()
         def call():
             resp = self._stub().LookupVolume(
                 master_pb2.LookupVolumeRequest(
@@ -146,6 +149,8 @@ class MasterClient:
                 "auth": resp.auth}
 
     def invalidate(self, volume_id: Optional[int] = None) -> None:
+        _cache_metrics.counter("cache_invalidations",
+                               tier="vidmap").inc()
         with self._lock:
             if volume_id is None:
                 self._vid_map.clear()

@@ -67,6 +67,15 @@ def total_size(chunks: list[FileChunk]) -> int:
     return max((c.offset + c.size for c in chunks), default=0)
 
 
+def chunk_file_ids(chunks: list[FileChunk]) -> list[str]:
+    """Distinct fids in chunk order — what a cache must drop when the
+    entry holding these chunks is overwritten or deleted."""
+    seen: dict[str, None] = {}
+    for c in chunks:
+        seen.setdefault(c.file_id)
+    return list(seen)
+
+
 def read_plan(chunks: list[FileChunk], offset: int,
               length: int) -> list[ReadPiece]:
     """Map [offset, offset+length) onto stored-chunk sub-reads. Gaps

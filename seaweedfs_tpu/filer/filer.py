@@ -22,8 +22,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from ..cache import chunk_key, fid_volume, global_chunk_cache
 from .entry import Attr, Entry, FileChunk, normalize_path, split_path
-from .filechunks import read_plan, total_size
+from .filechunks import chunk_file_ids, read_plan, total_size
 from .stores import FilerStore, MemoryStore
 
 
@@ -79,8 +80,14 @@ class Filer:
     MAX_SUB_QUEUE = 10_000
 
     def __init__(self, store: Optional[FilerStore] = None,
-                 signature: int = 0):
+                 signature: int = 0, chunk_cache=None):
         self.store = store or MemoryStore()
+        #: Hot-read chunk cache (weed chunk_cache analog): read_file
+        #: serves repeat chunk fetches from here instead of re-hitting
+        #: the volume servers. Defaults to the process-global cache so
+        #: the filer server and in-process gateways share one hot set.
+        self.chunk_cache = chunk_cache if chunk_cache is not None \
+            else global_chunk_cache()
         #: Stable per-filer id for replication loop prevention
         #: (reference: the filer store mints and PERSISTS a random
         #: signature, so a restart keeps its identity and a running
@@ -439,12 +446,23 @@ class Filer:
         length = max(0, min(length, size - offset))
         buf = bytearray(length)
         for piece in read_plan(entry.chunks, offset, length):
-            blob = operation.download(master, piece.file_id,
-                                      entry.attr.collection)
+            blob = self._fetch_chunk(master, piece.file_id,
+                                     entry.attr.collection)
             part = blob[piece.chunk_offset:
                         piece.chunk_offset + piece.length]
             buf[piece.buffer_offset:piece.buffer_offset + len(part)] = part
         return bytes(buf)
+
+    def _fetch_chunk(self, master, fid: str, collection: str) -> bytes:
+        """One whole stored chunk, through the hot-read cache."""
+        from ..cluster import operation
+
+        key = chunk_key(getattr(master, "master_url", ""), fid)
+        blob = self.chunk_cache.get(key)
+        if blob is None:
+            blob = operation.download(master, fid, collection)
+            self.chunk_cache.put(key, blob, volume=fid_volume(fid))
+        return blob
 
     def delete_file_and_chunks(self, path: str, master,
                                recursive: bool = False,
@@ -457,13 +475,16 @@ class Filer:
                                     signatures=signatures)
         self._delete_chunks_via(master, orphans, col)
 
-    @staticmethod
-    def _delete_chunks_via(master, chunks: list[FileChunk],
+    def _delete_chunks_via(self, master, chunks: list[FileChunk],
                            collection: str) -> None:
         from ..cluster import operation
 
-        for c in chunks:
+        master_url = getattr(master, "master_url", "")
+        for fid in chunk_file_ids(chunks):
+            # Cache first: a dead chunk must stop serving even when the
+            # best-effort blob delete below fails.
+            self.chunk_cache.invalidate(chunk_key(master_url, fid))
             try:
-                operation.delete(master, c.file_id, collection=collection)
+                operation.delete(master, fid, collection=collection)
             except Exception:
                 pass  # blob GC is best-effort, like the reference's

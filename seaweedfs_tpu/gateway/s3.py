@@ -336,9 +336,20 @@ class S3Gateway:
 
     def get_object(self, bucket: str, key: str, offset: int = 0,
                    length: Optional[int] = None) -> bytes:
-        self.get_object_entry(bucket, key)
-        return self.filer.get_data(f"{BUCKETS_DIR}/{bucket}/{key}",
-                                   offset, length)
+        entry = self.get_object_entry(bucket, key)
+        path = f"{BUCKETS_DIR}/{bucket}/{key}"
+        # Hot-read cache, keyed on content identity (etag covers the
+        # chunk list): an overwrite changes the etag, so stale entries
+        # can never serve — they just age out of the LRU.
+        from ..cache import global_chunk_cache
+
+        ckey = f"s3:{path}:{_etag(entry)}:{offset}:{length}"
+        cache = global_chunk_cache()
+        data = cache.get(ckey)
+        if data is None:
+            data = self.filer.get_data(path, offset, length)
+            cache.put(ckey, data)
+        return data
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._require_bucket(bucket)

@@ -14,10 +14,22 @@ import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..cache import global_chunk_cache
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..util import glog
 
 DAV_NS = "DAV:"
+
+
+def _entry_sig(entry) -> str:
+    """Content identity of an entry: its chunk fids + write stamps.
+    Part of the cache key, so a rewrite can never serve stale bytes."""
+    import hashlib
+
+    h = hashlib.blake2s(digest_size=8)
+    for c in entry.chunks:
+        h.update(f"{c.file_id}@{c.mtime_ns}".encode())
+    return h.hexdigest()
 
 
 def _rfc1123(ts: float) -> str:
@@ -160,11 +172,18 @@ def _make_handler(dav: WebDavServer):
             if entry.is_directory:
                 self._send(403)
                 return
-            try:
-                data = dav.filer.get_data(dav.fpath(path))
-            except FilerClientError:
-                self._send(404)
-                return
+            # Hot-read cache keyed on the entry's chunk identity — an
+            # overwrite mints new fids, so stale keys simply rot out.
+            cache = global_chunk_cache()
+            ckey = f"dav:{dav.fpath(path)}:{_entry_sig(entry)}"
+            data = cache.get(ckey)
+            if data is None:
+                try:
+                    data = dav.filer.get_data(dav.fpath(path))
+                except FilerClientError:
+                    self._send(404)
+                    return
+                cache.put(ckey, data)
             self._send(200, data, entry.attributes.mime
                        or "application/octet-stream")
 

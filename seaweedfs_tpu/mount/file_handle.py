@@ -14,51 +14,19 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
-from typing import Optional
 
+from ..cache import ChunkCache  # noqa: F401 — re-export; the mount
+# package's cache is the shared tiered implementation now (SLRU +
+# admission + TTL + optional disk tier, seaweedfs_tpu/cache/).
 from ..cluster import operation
 from ..filer.entry import FileChunk
-from .pages import DirtyPages
+from .pages import DirtyPages, ReadPages
 
 #: Flush a handle automatically once this much dirty data accumulates
 #: (weed mount's writeback threshold role).
 MAX_DIRTY_BYTES = 16 * 1024 * 1024
 #: Cap one uploaded chunk (large sequential writes split into several).
 CHUNK_SIZE = 4 * 1024 * 1024
-
-
-class ChunkCache:
-    """Tiny process-wide LRU of fetched chunk payloads."""
-
-    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024):
-        self.capacity = capacity_bytes
-        self._lock = threading.Lock()
-        self._held = 0
-        self._map: OrderedDict[str, bytes] = OrderedDict()
-
-    def get(self, fid: str) -> Optional[bytes]:
-        with self._lock:
-            data = self._map.get(fid)
-            if data is not None:
-                self._map.move_to_end(fid)
-            return data
-
-    def put(self, fid: str, data: bytes) -> None:
-        with self._lock:
-            if fid in self._map:
-                return
-            self._map[fid] = data
-            self._held += len(data)
-            while self._held > self.capacity and self._map:
-                _, old = self._map.popitem(last=False)
-                self._held -= len(old)
-
-    def invalidate(self, fid: str) -> None:
-        with self._lock:
-            old = self._map.pop(fid, None)
-            if old is not None:
-                self._held -= len(old)
 
 
 class FileHandle:
@@ -72,6 +40,7 @@ class FileHandle:
         self.entry = entry  # filer_pb2.Entry snapshot (mutated locally)
         self.flags = flags
         self.pages = DirtyPages()
+        self.read_pages = ReadPages()
         self._lock = threading.RLock()
         self._size = max(
             entry.attributes.file_size,
@@ -91,25 +60,33 @@ class FileHandle:
             end = min(offset + length, self.size)
             if end <= offset:
                 return b""
-            buf = bytearray(end - offset)
-            chunks = [FileChunk(file_id=c.file_id, offset=c.offset,
-                                size=c.size, mtime_ns=c.mtime_ns)
-                      for c in self.entry.chunks]
-            from ..filer.filechunks import read_plan
-            for piece in read_plan(chunks, offset, len(buf)):
-                blob = self.wfs._fetch_chunk(piece.file_id)
-                seg = blob[piece.chunk_offset:
-                           piece.chunk_offset + piece.length]
-                buf[piece.buffer_offset:
-                    piece.buffer_offset + len(seg)] = seg
+            buf = bytearray(self.read_pages.read(
+                offset, end - offset, self._read_clean))
             self.pages.overlay(offset, buf)
             return bytes(buf)
+
+    def _read_clean(self, offset: int, length: int) -> bytes:
+        """Flushed-chunk bytes only (no dirty overlay) — the fetch
+        callback behind ``read_pages``."""
+        buf = bytearray(length)
+        chunks = [FileChunk(file_id=c.file_id, offset=c.offset,
+                            size=c.size, mtime_ns=c.mtime_ns)
+                  for c in self.entry.chunks]
+        from ..filer.filechunks import read_plan
+        for piece in read_plan(chunks, offset, length):
+            blob = self.wfs._fetch_chunk(piece.file_id)
+            seg = blob[piece.chunk_offset:
+                       piece.chunk_offset + piece.length]
+            buf[piece.buffer_offset:
+                piece.buffer_offset + len(seg)] = seg
+        return bytes(buf)
 
     # ------------- write -------------
 
     def write(self, offset: int, data: bytes) -> int:
         with self._lock:
             self.pages.write(offset, data)
+            self.read_pages.invalidate(offset, len(data))
             self._size = max(self._size, offset + len(data))
             if self.pages.dirty_bytes >= MAX_DIRTY_BYTES:
                 self.flush()
@@ -118,6 +95,7 @@ class FileHandle:
     def truncate(self, size: int) -> None:
         with self._lock:
             self.pages.truncate(size)
+            self.read_pages.invalidate()
             if size < self._size or size < self.size:
                 # Shrink: drop shadowed chunk ranges entirely when the
                 # chunk lies wholly past the cut; clip the logical size.

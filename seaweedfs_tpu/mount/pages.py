@@ -1,4 +1,4 @@
-"""Dirty-page cache for the mount layer's write path.
+"""Page caches for the mount layer: dirty writes and clean reads.
 
 Mirrors weed/mount's ContinuousDirtyPages (SURVEY.md §2 "FUSE mount"):
 writes land in RAM as byte intervals; overlapping/adjacent intervals
@@ -7,11 +7,18 @@ each interval as a file chunk (the chunked-flush half lives in
 file_handle.py). Reads through an open handle overlay the dirty
 intervals on whatever the stored chunks say, so read-your-writes holds
 before any flush.
+
+``ReadPages`` is the read-side counterpart (the reference's
+ChunkedFileReader / reader-cache role): a small per-handle cache of
+page-aligned CLEAN file bytes, so a kernel re-reading the same pages —
+the normal FUSE pattern — doesn't re-walk the chunk plan each time.
+Dirty bytes never enter it; writes invalidate the pages they touch.
 """
 
 from __future__ import annotations
 
 import bisect
+from collections import OrderedDict
 from typing import Callable, Optional
 
 
@@ -101,3 +108,91 @@ class DirtyPages:
 
     def __bool__(self) -> bool:
         return bool(self._iv)
+
+
+class ReadPages:
+    """LRU of page-aligned clean-read spans for one open handle.
+
+    ``read`` composes the requested range from cached pages, fetching
+    missing pages in one batched ``fetch(offset, length)`` call per
+    contiguous gap (so a cold sequential read costs the same chunk-plan
+    walk it did before). Only flushed bytes belong here — the caller
+    overlays its dirty intervals AFTER, and must ``invalidate`` the
+    range of every write (post-flush those offsets change meaning).
+    """
+
+    def __init__(self, page_size: int = 128 * 1024,
+                 max_pages: int = 64):
+        self.page_size = max(4096, int(page_size))
+        self.max_pages = max(1, int(max_pages))
+        self._pages: OrderedDict[int, bytes] = OrderedDict()
+
+    def read(self, offset: int, length: int,
+             fetch: Callable[[int, int], bytes]) -> bytes:
+        if length <= 0:
+            return b""
+        ps = self.page_size
+        first = offset // ps
+        last = (offset + length - 1) // ps
+        out = bytearray(length)
+        p = first
+        while p <= last:
+            page = self._pages.get(p)
+            if page is None:
+                run_end = p
+                while run_end <= last and run_end not in self._pages:
+                    run_end += 1
+                blob = fetch(p * ps, (run_end - p) * ps)
+                for i in range(p, run_end):
+                    self._put_page(i, bytes(
+                        blob[(i - p) * ps:(i - p + 1) * ps]))
+                # Serve this request from the blob itself, not the LRU:
+                # a run longer than max_pages evicts its own head before
+                # the copy-back would reach it.
+                blob_start = p * ps
+                lo = max(offset, blob_start)
+                hi = min(offset + length, blob_start + len(blob))
+                if lo < hi:
+                    out[lo - offset:hi - offset] = \
+                        blob[lo - blob_start:hi - blob_start]
+                p = run_end
+            else:
+                self._pages.move_to_end(p)
+                self._copy(p, offset, out)
+                p += 1
+        return bytes(out)
+
+    def _put_page(self, idx: int, data: bytes) -> None:
+        self._pages[idx] = data
+        self._pages.move_to_end(idx)
+        while len(self._pages) > self.max_pages:
+            self._pages.popitem(last=False)
+
+    def _copy(self, idx: int, offset: int, out: bytearray) -> None:
+        page = self._pages.get(idx, b"")
+        page_start = idx * self.page_size
+        lo = max(offset, page_start)
+        hi = min(offset + len(out), page_start + len(page))
+        if lo < hi:
+            out[lo - offset:hi - offset] = \
+                page[lo - page_start:hi - page_start]
+
+    def invalidate(self, offset: int = 0,
+                   length: Optional[int] = None) -> None:
+        """Drop pages overlapping [offset, offset+length); None length
+        means everything from ``offset`` on."""
+        ps = self.page_size
+        first = offset // ps
+        if length is None:
+            dead = [i for i in self._pages if i >= first]
+        else:
+            if length <= 0:
+                return
+            last = (offset + length - 1) // ps
+            dead = [i for i in self._pages if first <= i <= last]
+        for i in dead:
+            del self._pages[i]
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._pages)

@@ -68,6 +68,7 @@ class WFS:
             except Exception:  # noqa: BLE001 — close() must not raise
                 pass
         self._handles.clear()
+        self.chunk_cache.close()
         self.filer.close()
         self.master.close()
 
@@ -81,9 +82,10 @@ class WFS:
     def _fetch_chunk(self, fid: str) -> bytes:
         data = self.chunk_cache.get(fid)
         if data is None:
+            from ..cache import fid_volume
             data = operation.download(self.master, fid,
                                       collection=self.collection)
-            self.chunk_cache.put(fid, data)
+            self.chunk_cache.put(fid, data, volume=fid_volume(fid))
         return data
 
     def _save_entry(self, path: str, entry) -> None:

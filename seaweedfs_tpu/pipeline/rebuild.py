@@ -98,6 +98,11 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     finally:
         for f in ins + outs:
             f.close()
+    # Shard files changed under any reader holding cached post-decode
+    # needles for this volume — tell every live chunk cache.
+    from ..cache import invalidation as cache_invalidation
+
+    cache_invalidation.base_invalidated(base, reason="ec-rebuild")
     return missing
 
 

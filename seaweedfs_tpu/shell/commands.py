@@ -365,6 +365,47 @@ def cmd_volume_delete(env: CommandEnv, argv: list[str]) -> None:
     env.println(f"volume.delete {args.volumeId}: done")
 
 
+@command("cache.status")
+def cmd_cache_status(env: CommandEnv, argv: list[str]) -> None:
+    """Hit/miss/eviction and occupancy counters of the process-wide
+    chunk cache (docs/cache.md)."""
+    p = _parser("cache.status")
+    p.parse_args(argv)
+    from ..cache import global_chunk_cache, invalidation
+    st = global_chunk_cache().stats()
+    env.println(f"cache.status hits={st['hits']} misses={st['misses']} "
+                f"hit_ratio={st['hit_ratio']:.3f}")
+    env.println(f"  memory: {st['memory_entries']} entries "
+                f"{st['memory_bytes']}/{st['memory_capacity']} bytes "
+                f"(protected={st['protected_bytes']} "
+                f"probation={st['probation_bytes']})")
+    if "disk_entries" in st:
+        env.println(f"  disk: {st['disk_entries']} entries "
+                    f"{st['disk_bytes']}/{st['disk_capacity']} bytes")
+    else:
+        env.println("  disk: tier disabled")
+    env.println(f"  evictions={st['evictions']} "
+                f"admission_rejects={st['admission_rejects']} "
+                f"ttl_seconds={st['ttl_seconds']}")
+    if invalidation.events:
+        pairs = " ".join(f"{k}={v}"
+                         for k, v in sorted(invalidation.events.items()))
+        env.println(f"  invalidations: {pairs}")
+
+
+@command("cache.clear")
+def cmd_cache_clear(env: CommandEnv, argv: list[str]) -> None:
+    """Drop every cached chunk (memory and disk tiers)."""
+    p = _parser("cache.clear")
+    p.parse_args(argv)
+    from ..cache import global_chunk_cache
+    cache = global_chunk_cache()
+    st = cache.stats()
+    dropped = st["memory_entries"] + st.get("disk_entries", 0)
+    cache.clear()
+    env.println(f"cache.clear: dropped {dropped} entries")
+
+
 def run_command(env: CommandEnv, line: str) -> None:
     """Parse and run one shell line."""
     parts = shlex.split(line)

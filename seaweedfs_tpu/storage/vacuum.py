@@ -163,10 +163,18 @@ def commit_compact(vol: Volume, state: CompactState) -> int:
         # a stream of overlapping reads cannot starve the drain.
         vol._swap_pending = True
         try:
-            return _commit_swap_drained(vol, state)
+            size = _commit_swap_drained(vol, state)
         finally:
             vol._swap_pending = False
             vol._no_readers.notify_all()
+    # The compacted files are live: any chunk cache still holding
+    # pre-compaction payloads for this volume must drop them before the
+    # next read (fans out to every registered ChunkCache). Outside the
+    # volume lock — listeners take their own locks.
+    from ..cache import invalidation as cache_invalidation
+
+    cache_invalidation.volume_invalidated(vol.volume_id, reason="vacuum")
+    return size
 
 
 def _commit_swap_drained(vol: Volume, state: CompactState) -> int:

@@ -9,8 +9,15 @@ like ``weed scaffold``.
 
 from __future__ import annotations
 
-import tomllib
 from pathlib import Path
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None  # fall back to the subset parser below
 
 SCAFFOLDS = {
     "security": """\
@@ -36,6 +43,19 @@ copy_2 = 6
 copy_3 = 3
 copy_other = 1
 """,
+    "cache": """\
+# cache.toml — tiered chunk cache for read paths (docs/cache.md).
+[cache]
+memory_bytes = 67108864          # in-memory tier capacity (64 MiB)
+admission_max_fraction = 0.125   # reject blobs larger than this share
+ttl_seconds = 0                  # 0 disables time-based expiry
+protected_fraction = 0.8         # SLRU protected-segment share
+
+[cache.disk]
+dir = ""                         # empty disables the on-disk tier
+capacity_bytes = 268435456       # 256 MiB across all segment files
+segments = 4
+""",
 }
 
 
@@ -44,8 +64,57 @@ def load(path: str | Path) -> dict:
     p = Path(path)
     if not p.exists():
         return {}
-    with open(p, "rb") as f:
-        return tomllib.load(f)
+    if tomllib is not None:
+        with open(p, "rb") as f:
+            return tomllib.load(f)
+    return _parse_toml_subset(p.read_text())
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Parser for the TOML subset the scaffolds use — ``[a.b]`` tables
+    and string/int/float/bool scalars with ``#`` comments. Interpreters
+    without tomllib (and without a tomli wheel) land here; anything
+    fancier than the subset raises rather than mis-parsing."""
+    root: dict = {}
+    table = root
+    for lineno, line in enumerate(text.splitlines(), 1):
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        if s.startswith("[") and s.endswith("]"):
+            table = root
+            for part in s[1:-1].split("."):
+                table = table.setdefault(part.strip(), {})
+            continue
+        key, eq, raw = s.partition("=")
+        if not eq:
+            raise ValueError(
+                f"toml line {lineno}: expected key = value: {line!r}")
+        table[key.strip()] = _parse_scalar(raw.strip(), lineno)
+    return root
+
+
+def _parse_scalar(raw: str, lineno: int):
+    if raw.startswith('"'):
+        end = raw.find('"', 1)
+        while end != -1 and raw[end - 1] == "\\":
+            end = raw.find('"', end + 1)
+        if end == -1:
+            raise ValueError(f"toml line {lineno}: unterminated string")
+        return raw[1:end].replace('\\"', '"').replace("\\\\", "\\")
+    raw = raw.split("#", 1)[0].strip()
+    low = raw.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(raw, 0)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"toml line {lineno}: unsupported value {raw!r}") from None
 
 
 def lookup(conf: dict, dotted: str, default=None):

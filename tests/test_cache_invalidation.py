@@ -1,0 +1,138 @@
+"""Cache invalidation hooks: vacuum and EC rebuild must drop stale
+entries, and the volume server's post-decode needle cache must pay the
+Reed-Solomon decode exactly once for a hot cold-tier needle."""
+
+import pytest
+
+from seaweedfs_tpu.cache import ChunkCache, invalidation
+from seaweedfs_tpu.pipeline.encode import encode_volume
+from seaweedfs_tpu.pipeline.read import EcVolumeReader
+from seaweedfs_tpu.pipeline.rebuild import rebuild_ec_files
+from seaweedfs_tpu.pipeline.scheme import EcScheme
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.types import FileId
+from seaweedfs_tpu.storage.volume import generate_synthetic_volume
+
+TEST_SCHEME = EcScheme(data_shards=10, parity_shards=4,
+                       large_block_size=2048, small_block_size=256)
+
+
+def test_vacuum_drops_stale_cache_entries(tmp_path):
+    """write -> cache-warm -> overwrite -> vacuum -> read is fresh."""
+    base = tmp_path / "3"
+    vol = generate_synthetic_volume(base, 3, n_needles=20, seed=1)
+    cache = ChunkCache(1 << 20)
+
+    def read_through(key: int) -> bytes:
+        ck = f"vol3:{key}"
+        b = cache.get(ck)
+        if b is None:
+            b = vol.read_needle(key).data
+            cache.put(ck, b, volume=3)
+        return b
+
+    old = read_through(5)
+    assert read_through(5) == old            # warm: served from cache
+
+    fresh = b"fresh-bytes-after-overwrite" * 4
+    n5 = vol.read_needle(5)
+    vol.write_needle(Needle(cookie=n5.cookie, id=5, data=fresh,
+                            append_at_ns=1_800_000_000_000_000_000))
+    # the cache is now stale — and still serving the shadowed bytes
+    assert read_through(5) == old
+
+    assert vacuum_mod.vacuum(vol, threshold=0.0) is not None
+    assert invalidation.events.get("vacuum", 0) >= 1
+    # vacuum's commit hook invalidated volume 3 in every live cache
+    assert read_through(5) == fresh
+    cache.close()
+    vol.close()
+
+
+def test_ec_rebuild_invalidates_volume(tmp_path):
+    base = tmp_path / "7"
+    vol = generate_synthetic_volume(base, 7, n_needles=60, avg_size=300,
+                                    seed=2)
+    vol.close()
+    encode_volume(base, TEST_SCHEME)
+    ec_files.shard_path(base, 2).unlink()
+
+    cache = ChunkCache(1 << 20)
+    cache.put("ec:7:1:0", b"decoded-needle", volume=7)
+    assert rebuild_ec_files(base, TEST_SCHEME) == [2]
+    assert cache.get("ec:7:1:0") is None
+    assert invalidation.events.get("ec-rebuild", 0) >= 1
+    cache.close()
+
+
+@pytest.fixture
+def ec_only_store(tmp_path):
+    """A store holding only the EC artifacts of volume 7 (sealed, local
+    .dat/.idx gone — every read must go through shard intervals)."""
+    base = tmp_path / "7"
+    vol = generate_synthetic_volume(base, 7, n_needles=40, avg_size=300,
+                                    seed=3)
+    wanted = {k: vol.read_needle(k) for k in (1, 2, 3)}
+    vol.close()
+    # default scheme: the .vif records shard counts only, so the
+    # server-side reader always reopens with default block sizes
+    encode_volume(base)
+    (tmp_path / "7.dat").unlink()
+    (tmp_path / "7.idx").unlink()
+    store = Store([tmp_path])
+    store.load_existing()   # auto-mounts the shards found on disk
+    yield store, wanted
+    store.close()
+
+
+def test_hot_ec_needle_decodes_once(ec_only_store, monkeypatch):
+    """The satellite regression: repeated reads of a hot needle on a
+    cold (EC) volume must hit the post-decode cache, not re-run
+    interval assembly / RS decode per request."""
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    store, wanted = ec_only_store
+    vs = VolumeServer(store)   # never started: read_bytes is local
+
+    calls = {"read_record": 0}
+    orig = EcVolumeReader.read_record
+
+    def counting(self, key):
+        calls["read_record"] += 1
+        return orig(self, key)
+
+    monkeypatch.setattr(EcVolumeReader, "read_record", counting)
+    n1 = wanted[1]
+    fid = FileId(volume_id=7, key=1, cookie=n1.cookie)
+    reads = [vs.read_bytes(7, fid) for _ in range(5)]
+    assert all(r == n1.data for r in reads)
+    assert calls["read_record"] == 1, \
+        f"{calls['read_record']} decodes for 5 reads of one needle"
+
+    # a different needle is its own entry
+    n2 = wanted[2]
+    assert vs.read_bytes(7, FileId(7, 2, n2.cookie)) == n2.data
+    assert calls["read_record"] == 2
+
+    # invalidation (vacuum/rebuild would do this) forces a re-decode
+    invalidation.volume_invalidated(7, reason="test")
+    assert vs.read_bytes(7, fid) == n1.data
+    assert calls["read_record"] == 3
+    vs.chunk_cache.close()
+
+
+def test_volume_server_delete_invalidates_ec_entry(ec_only_store):
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    store, wanted = ec_only_store
+    vs = VolumeServer(store)
+    n3 = wanted[3]
+    fid = FileId(volume_id=7, key=3, cookie=n3.cookie)
+    assert vs.read_bytes(7, fid) == n3.data
+    assert vs._ec_cache_key(7, fid) in vs.chunk_cache
+    vs.chunk_cache.invalidate(vs._ec_cache_key(7, fid))
+    assert vs._ec_cache_key(7, fid) not in vs.chunk_cache
+    vs.chunk_cache.close()

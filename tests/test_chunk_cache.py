@@ -1,0 +1,356 @@
+"""Chunk-cache tiers (seaweedfs_tpu/cache/): admission, SLRU scan
+resistance, TTL, disk crash-restart reload, concurrency, and the
+zipfian hot-set hit-ratio the cache exists to deliver."""
+
+import hashlib
+import io
+import random
+import threading
+
+import pytest
+
+from seaweedfs_tpu.cache import (ChunkCache, DiskTier, SegmentedLRU,
+                                 chunk_key, configure_global, fid_volume,
+                                 global_chunk_cache, invalidation)
+from seaweedfs_tpu.cache.chunk_cache import _Entry
+
+
+def _payload(key: str, size: int = 200) -> bytes:
+    """Deterministic bytes for a key, so any get can be verified."""
+    h = hashlib.blake2s(key.encode()).digest()
+    return (h * (size // len(h) + 1))[:size]
+
+
+# ------------- keys -------------
+
+def test_fid_volume_and_chunk_key():
+    assert fid_volume("3,01637037d6") == 3
+    assert fid_volume("not-a-fid") is None
+    assert chunk_key("127.0.0.1:9333", "3,01637037d6") == \
+        "chunk:127.0.0.1:9333:3,01637037d6"
+    # distinct clusters must never share an entry
+    assert chunk_key("a:1", "3,01") != chunk_key("b:1", "3,01")
+
+
+# ------------- SLRU memory tier -------------
+
+def test_slru_scan_resistance():
+    """One large sequential scan must not evict the hot set."""
+    lru = SegmentedLRU(10_000, protected_fraction=0.8)
+    hot = [f"hot{i}" for i in range(5)]
+    for k in hot:
+        lru.put(k, _Entry(b"x" * 1000, 0.0, None))
+        lru.get(k)  # second touch -> protected
+    for i in range(50):  # a 50 KiB scan through a 10 KiB cache
+        lru.put(f"scan{i}", _Entry(b"y" * 1000, 0.0, None))
+    for k in hot:
+        assert k in lru, f"{k} evicted by a one-shot scan"
+
+
+def test_slru_protected_overflow_demotes():
+    lru = SegmentedLRU(4_000, protected_fraction=0.5)  # 2 KiB protected
+    for i in range(3):
+        lru.put(f"k{i}", _Entry(b"x" * 1000, 0.0, None))
+        lru.get(f"k{i}")
+    # only 2 of 3 fit in protected; the LRU one went back to probation
+    assert lru.protected_bytes <= 2_000
+    assert lru.entries == 3
+
+
+def test_eviction_order_prefers_probation():
+    lru = SegmentedLRU(3_000)
+    lru.put("hot", _Entry(b"x" * 1000, 0.0, None))
+    lru.get("hot")
+    lru.put("cold1", _Entry(b"x" * 1000, 0.0, None))
+    lru.put("cold2", _Entry(b"x" * 1000, 0.0, None))
+    evicted = lru.put("cold3", _Entry(b"x" * 1000, 0.0, None))
+    assert [k for k, _ in evicted] == ["cold1"]
+    assert "hot" in lru
+
+
+# ------------- admission control -------------
+
+def test_admission_rejects_oversized_from_memory():
+    c = ChunkCache(8_192, admission_max_fraction=0.125)  # max 1 KiB
+    assert c.put("big", b"z" * 2_000) is False
+    assert c.admission_rejects == 1
+    assert c.get("big") is None
+    assert c.put("ok", b"z" * 500) is True
+    assert c.get("ok") == b"z" * 500
+    c.close()
+
+
+def test_oversized_item_lands_on_disk_tier(tmp_path):
+    c = ChunkCache(8_192, admission_max_fraction=0.125,
+                   disk_dir=str(tmp_path / "d"))
+    assert c.put("big", _payload("big", 2_000)) is True
+    assert c.admission_rejects == 1
+    assert c.stats()["memory_entries"] == 0
+    assert c.get("big") == _payload("big", 2_000)  # disk hit
+    c.close()
+
+
+# ------------- TTL -------------
+
+def test_ttl_expiry_with_injected_clock(tmp_path):
+    now = [1000.0]
+    c = ChunkCache(1 << 20, ttl_seconds=10.0,
+                   disk_dir=str(tmp_path / "d"), clock=lambda: now[0])
+    c.put("k", b"v")
+    assert c.get("k") == b"v"
+    now[0] += 11.0
+    assert c.get("k") is None       # both tiers expired
+    assert "k" not in c
+    c.close()
+
+
+def test_per_put_ttl_overrides_default():
+    now = [0.0]
+    c = ChunkCache(1 << 20, ttl_seconds=0.0, clock=lambda: now[0])
+    c.put("forever", b"a")
+    c.put("brief", b"b", ttl=5.0)
+    now[0] = 6.0
+    assert c.get("forever") == b"a"
+    assert c.get("brief") is None
+    c.close()
+
+
+# ------------- two-tier flow -------------
+
+def test_memory_eviction_demotes_to_disk_and_promotes_back(tmp_path):
+    c = ChunkCache(2_048, admission_max_fraction=0.5,
+                   disk_dir=str(tmp_path / "d"))
+    c.put("a", _payload("a", 1000))
+    c.put("b", _payload("b", 1000))
+    c.put("c", _payload("c", 1000))   # evicts "a" -> disk
+    st = c.stats()
+    assert st["disk_entries"] >= 1
+    assert c.get("a") == _payload("a", 1000)   # disk hit, promoted
+    assert c.stats()["hits"] == 1
+    assert "a" in c
+    c.close()
+
+
+def test_invalidate_key_drops_both_tiers(tmp_path):
+    c = ChunkCache(1 << 20, disk_dir=str(tmp_path / "d"))
+    c.put("k", b"v", volume=7)
+    c.invalidate("k")
+    assert c.get("k") is None
+    assert c.invalidate_volume(7) == 0   # already untracked
+    c.close()
+
+
+def test_invalidate_volume_scopes_to_tagged_keys():
+    c = ChunkCache(1 << 20)
+    c.put("v1a", b"x", volume=1)
+    c.put("v1b", b"y", volume=1)
+    c.put("v2", b"z", volume=2)
+    assert c.invalidate_volume(1) == 2
+    assert c.get("v1a") is None and c.get("v1b") is None
+    assert c.get("v2") == b"z"
+    c.close()
+
+
+def test_registry_reaches_every_live_cache():
+    c1, c2 = ChunkCache(1 << 20), ChunkCache(1 << 20)
+    c1.put("k1", b"a", volume=9)
+    c2.put("k2", b"b", volume=9)
+    invalidation.volume_invalidated(9, reason="test")
+    assert c1.get("k1") is None and c2.get("k2") is None
+    assert invalidation.events.get("test", 0) >= 1
+    c1.close()
+    c2.close()
+
+
+# ------------- disk tier durability -------------
+
+def test_disk_crash_restart_reload(tmp_path):
+    d = str(tmp_path / "d")
+    # memory holds ONE 200-byte entry, so every newer put demotes the
+    # previous one to the disk tier
+    c = ChunkCache(250, admission_max_fraction=1.0, disk_dir=d)
+    for i in range(5):
+        c.put(f"k{i}", _payload(f"k{i}"), volume=i % 2)
+    c.close()
+
+    c2 = ChunkCache(250, admission_max_fraction=1.0, disk_dir=d)
+    # memory is cold but the disk index replayed every demoted record
+    # (k4 never left memory — a crash legitimately loses it)
+    for i in range(4):
+        assert c2.get(f"k{i}") == _payload(f"k{i}")
+    # the per-volume index was rebuilt from record headers too
+    assert c2.invalidate_volume(1) >= 1
+    c2.close()
+
+
+def test_disk_tier_survives_torn_tail(tmp_path):
+    d = tmp_path / "d"
+    t = DiskTier(d, capacity_bytes=1 << 20, segments=2)
+    t.put("whole", _payload("whole"), None, 0.0)
+    t.close()
+    # simulate a crash mid-append: garbage half-record at the tail
+    seg = d / "cache_0.dat"
+    with open(seg, "ab") as f:
+        f.write(b"\xc5\x00\x00")  # magic then truncated header
+    t2 = DiskTier(d, capacity_bytes=1 << 20, segments=2)
+    got = t2.get("whole")
+    assert got is not None and got[0] == _payload("whole")
+    assert t2.entries == 1
+    t2.close()
+
+
+def test_disk_tier_rotation_evicts_whole_segments(tmp_path):
+    t = DiskTier(tmp_path / "d", capacity_bytes=8_192, segments=2)
+    for i in range(40):   # way past capacity -> several rotations
+        t.put(f"k{i}", _payload(f"k{i}", 500), None, 0.0)
+    assert t.evictions > 0
+    assert t.bytes <= 8_192
+    # newest records always survive
+    assert t.get("k39")[0] == _payload("k39", 500)
+    t.close()
+
+
+# ------------- concurrency -------------
+
+def test_concurrent_readers_writers_and_invalidation(tmp_path):
+    c = ChunkCache(32_768, admission_max_fraction=0.5,
+                   disk_dir=str(tmp_path / "d"),
+                   disk_capacity_bytes=65_536, disk_segments=2)
+    keys = [f"key{i}" for i in range(64)]
+    errors: list[str] = []
+    stop = threading.Event()
+
+    def worker(seed: int) -> None:
+        rng = random.Random(seed)
+        try:
+            for _ in range(1500):
+                k = rng.choice(keys)
+                got = c.get(k)
+                if got is None:
+                    c.put(k, _payload(k), volume=int(k[3:]) % 4)
+                elif got != _payload(k):
+                    errors.append(f"corrupt read for {k}")
+                    return
+                if rng.random() < 0.01:
+                    c.invalidate_volume(rng.randrange(4))
+                if rng.random() < 0.005:
+                    c.invalidate(rng.choice(keys))
+        except Exception as e:  # noqa: BLE001 — surfaced via errors
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "stress worker wedged"
+    stop.set()
+    assert errors == []
+    st = c.stats()
+    assert st["hits"] > 0 and st["misses"] > 0
+    c.close()
+
+
+# ------------- the point of the cache -------------
+
+def test_zipfian_hot_workload_hit_ratio():
+    """10% of keys take 90% of the traffic (the zipf head); the cache
+    holds roughly the hot set and must deliver >= 80% hits overall."""
+    n_keys, hot_frac = 100, 0.10
+    hot = [f"obj{i}" for i in range(int(n_keys * hot_frac))]
+    cold = [f"obj{i}" for i in range(len(hot), n_keys)]
+    c = ChunkCache(16_000, admission_max_fraction=0.2)  # ~16 entries
+
+    rng = random.Random(42)
+    fetches = 0
+
+    def read_through(k: str) -> bytes:
+        nonlocal fetches
+        b = c.get(k)
+        if b is None:
+            fetches += 1
+            b = _payload(k, 1000)
+            c.put(k, b)
+        return b
+
+    accesses = 4000
+    for _ in range(accesses):
+        k = rng.choice(hot) if rng.random() < 0.9 else rng.choice(cold)
+        assert read_through(k) == _payload(k, 1000)
+
+    st = c.stats()
+    assert st["hits"] + st["misses"] == accesses
+    assert st["hit_ratio"] >= 0.80, f"hit ratio {st['hit_ratio']:.3f}"
+    assert fetches == st["misses"]
+    c.close()
+
+
+# ------------- shell + config surface -------------
+
+def test_shell_cache_status_and_clear(tmp_path):
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    from seaweedfs_tpu.storage.store import Store
+
+    configure_global(disk_dir=str(tmp_path / "d"))
+    try:
+        cache = global_chunk_cache()
+        cache.put("k", b"x" * 64)
+        cache.get("k")
+        (tmp_path / "s").mkdir()
+        out = io.StringIO()
+        env = CommandEnv(store=Store([str(tmp_path / "s")]), out=out)
+        run_command(env, "cache.status")
+        text = out.getvalue()
+        assert "hits=1" in text and "disk:" in text
+        run_command(env, "cache.clear")
+        assert "dropped 1 entries" in out.getvalue()
+        assert cache.get("k") is None
+        env.store.close()
+    finally:
+        configure_global()  # restore a pristine default global
+
+
+def test_from_config_honors_scaffold_knobs(tmp_path):
+    from seaweedfs_tpu.cache import from_config
+    from seaweedfs_tpu.util import config as config_mod
+
+    p = tmp_path / "cache.toml"
+    p.write_text(config_mod.scaffold("cache").replace(
+        'dir = ""', f'dir = "{tmp_path / "tier"}"'))
+    conf = config_mod.load(p)
+    c = from_config(conf)
+    st = c.stats()
+    assert st["memory_capacity"] == 67108864
+    assert st["disk_capacity"] == 268435456
+    assert c.admission_max == int(67108864 * 0.125)
+    c.close()
+
+
+def test_read_pages_run_longer_than_lru_capacity():
+    # Regression: a single cold read spanning more pages than the LRU
+    # holds must still return the fetched bytes (the head of the run
+    # used to be evicted by its own tail before the copy-back).
+    from seaweedfs_tpu.mount.pages import ReadPages
+
+    rp = ReadPages(page_size=4096, max_pages=8)
+    blob = bytes(range(256)) * (4096 * 20 // 256)
+
+    def fetch(off, length):
+        out = bytearray(length)
+        end = min(off + length, len(blob))
+        if end > off:
+            out[: end - off] = blob[off:end]
+        return bytes(out)
+
+    assert rp.read(0, len(blob), fetch) == blob  # 20 pages > 8 slots
+    assert rp.cached_pages <= 8
+    # warm tail pages still serve without re-fetch
+    calls = []
+
+    def counting_fetch(off, length):
+        calls.append((off, length))
+        return fetch(off, length)
+
+    tail = rp.read(len(blob) - 4096, 4096, counting_fetch)
+    assert tail == blob[-4096:] and calls == []

@@ -37,6 +37,9 @@ def test_help_lists_every_command():
 
 
 def test_tls_gen_writes_pair(tmp_path):
+    import pytest
+    pytest.importorskip(
+        "cryptography", reason="tls.gen needs the cryptography pkg")
     r = _run("tls.gen", "-dir", str(tmp_path / "certs"))
     assert r.returncode == 0
     for key in ("ca =", "cert =", "key ="):

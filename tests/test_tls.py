@@ -10,6 +10,9 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="cert generation needs the cryptography pkg")
+
 from seaweedfs_tpu.cluster.master import MasterServer, _grpc_port
 from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.cluster import operation
