@@ -22,6 +22,7 @@ import time
 from collections import OrderedDict
 from typing import Optional
 
+from ..util import tracing
 from ..util.stats import Metrics
 from . import invalidation
 from .disk_tier import DiskTier
@@ -217,6 +218,18 @@ class ChunkCache:
     # ------------- api -------------
 
     def get(self, key: str) -> Optional[bytes]:
+        if not tracing.active():
+            return self._get_inner(key)
+        with tracing.span("cache.get") as sp:
+            data = self._get_inner(key)
+            if data is None:
+                sp.tags = {"hit": "false"}
+            else:
+                sp.n_bytes = len(data)
+                sp.tags = {"hit": "true"}
+            return data
+
+    def _get_inner(self, key: str) -> Optional[bytes]:
         now = self.clock()
         with self._lock:
             e = self._mem.get(key)
@@ -247,6 +260,17 @@ class ChunkCache:
 
     def put(self, key: str, data: bytes, volume: Optional[int] = None,
             ttl: Optional[float] = None) -> bool:
+        if not tracing.active():
+            return self._put_inner(key, data, volume, ttl)
+        with tracing.span("cache.put") as sp:
+            sp.n_bytes = len(data)
+            admitted = self._put_inner(key, data, volume, ttl)
+            sp.tag(admitted=str(admitted).lower())
+            return admitted
+
+    def _put_inner(self, key: str, data: bytes,
+                   volume: Optional[int] = None,
+                   ttl: Optional[float] = None) -> bool:
         data = bytes(data)
         ttl_eff = self.ttl if ttl is None else float(ttl)
         expires = self.clock() + ttl_eff if ttl_eff > 0 else 0.0

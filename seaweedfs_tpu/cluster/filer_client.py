@@ -22,6 +22,7 @@ from .. import pb
 from ..pb import filer_pb2
 from .master import _grpc_port
 from ..util import tls as tls_mod
+from ..util import tracing
 
 
 def _with_signatures(query: str, signatures: tuple) -> str:
@@ -138,25 +139,32 @@ class FilerClient:
                  query: str = "", signatures: tuple = ()) -> dict:
         query = _with_signatures(query, signatures)
         req = urllib.request.Request(self._url(path, query), data=data,
-                                     method="PUT")
+                                     method="PUT",
+                                     headers=tracing.inject({}))
         if mime:
             req.add_header("Content-Type", mime)
         try:
-            with urllib.request.urlopen(req, timeout=120) as r:
-                return json.loads(r.read() or b"{}")
+            with tracing.span("filer.put", path=path) as sp:
+                sp.n_bytes = len(data)
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return json.loads(r.read() or b"{}")
         except urllib.error.HTTPError as e:
             raise FilerClientError(
                 f"PUT {path}: {e.code} {e.read()!r}") from e
 
     def get_data(self, path: str, offset: int = 0,
                  length: Optional[int] = None) -> bytes:
-        req = urllib.request.Request(self._url(path))
+        req = urllib.request.Request(self._url(path),
+                                     headers=tracing.inject({}))
         if offset or length is not None:
             stop = "" if length is None else str(offset + length - 1)
             req.add_header("Range", f"bytes={offset}-{stop}")
         try:
-            with urllib.request.urlopen(req, timeout=120) as r:
-                return r.read()
+            with tracing.span("filer.get", path=path) as sp:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    data = r.read()
+                sp.n_bytes = len(data)
+                return data
         except urllib.error.HTTPError as e:
             err = FilerClientError(f"GET {path}: {e.code}")
             err.code = e.code  # lets callers tell 404 from transient
@@ -252,7 +260,8 @@ class FilerClient:
                     signatures: tuple = ()) -> None:
         q = _with_signatures("recursive=true" if recursive else "",
                              signatures)
-        req = urllib.request.Request(self._url(path, q), method="DELETE")
+        req = urllib.request.Request(self._url(path, q), method="DELETE",
+                                     headers=tracing.inject({}))
         try:
             with urllib.request.urlopen(req, timeout=120) as r:
                 r.read()

@@ -26,7 +26,8 @@ from ..filer.filechunks import total_size
 from ..filer.stores import MemoryStore, SqliteStore
 from ..pb import filer_pb2
 from ..util import glog
-from ..util.stats import Metrics
+from ..util import tracing
+from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from .master import _grpc_port
 from .wdclient import MasterClient
 from ..util import tls as tls_mod
@@ -355,6 +356,17 @@ def _make_http_handler(fs: FilerServer):
             self._send(code, json.dumps({"error": msg}).encode())
 
         def do_GET(self):
+            u = urlparse(self.path)
+            if u.path == "/metrics":
+                self._send(200, (fs.metrics.render()
+                                 + tracing.METRICS.render()).encode(),
+                           EXPOSITION_CONTENT_TYPE)
+                return
+            if u.path == "/debug/traces":
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                self._send(200, json.dumps(tracing.debug_payload(
+                    int(q["limit"]) if "limit" in q else None)).encode())
+                return
             path, q = self._path()
             fs.metrics.counter("request_total", method="GET").inc()
             entry = fs.filer.find_entry(path)
@@ -499,7 +511,7 @@ def _make_http_handler(fs: FilerServer):
                 return
             self._send(204)
 
-    return Handler
+    return tracing.instrument_http_handler(Handler, "filer")
 
 
 def _parse_range(header, size: int):
@@ -562,8 +574,9 @@ def main(argv: list[str]) -> int:
                    help="security.toml (jwt signing key, [grpc.tls])")
     args = p.parse_args(argv)
     from ..util import config as config_mod
-    tls_mod.install_from_config(
-        config_mod.load(args.config) if args.config else {})
+    conf = config_mod.load(args.config) if args.config else {}
+    tls_mod.install_from_config(conf)
+    tracing.configure_from(conf)
     store = SqliteStore(args.db) if args.db else MemoryStore()
     filer = Filer(store)
     server = FilerServer(filer, ip=args.ip, port=args.port,

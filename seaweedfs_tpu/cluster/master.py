@@ -33,7 +33,8 @@ from ..util import config as config_mod
 from ..util import glog
 from ..util import security
 from ..util import tls as tls_mod
-from ..util.stats import Metrics
+from ..util import tracing
+from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import ha as ha_mod
 from .ha import NotLeaderError
 from .sequence import MemorySequencer
@@ -666,12 +667,18 @@ def _make_http_handler(ms: MasterServer):
                                 "AdminLockHolder": lock_holder,
                                 "Topology": ms.topology.to_map()})
                 elif u.path == "/metrics":
-                    body = ms.metrics.render().encode()
+                    body = (ms.metrics.render()
+                            + tracing.METRICS.render()).encode()
                     self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Type",
+                                     EXPOSITION_CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif u.path == "/debug/traces":
+                    self._json(tracing.debug_payload(
+                        int(q.get("limit", -1))
+                        if q.get("limit") else None))
                 else:
                     self._json({"error": "not found"}, 404)
             except NotLeaderError as e:
@@ -722,7 +729,7 @@ def _make_http_handler(ms: MasterServer):
             else:
                 self.do_GET()
 
-    return Handler
+    return tracing.instrument_http_handler(Handler, "master")
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -747,6 +754,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
     tls_mod.install_from_config(conf)
+    tracing.configure_from(conf)
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,
                       default_replication=args.defaultReplication,

@@ -16,6 +16,7 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Optional
 
+from ..util import tracing
 from .wdclient import MasterClient
 
 
@@ -69,12 +70,15 @@ def upload(server_url: str, fid: str, data: bytes, jwt: str = "",
     url = f"http://{server_url}/{fid}"
     if collection:
         url += f"?collection={collection}"
-    req = urllib.request.Request(url, data=data, method="POST")
+    req = urllib.request.Request(
+        url, data=data, method="POST", headers=tracing.inject({}))
     if jwt:
         req.add_header("Authorization", f"BEARER {jwt}")
     try:
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            return json.loads(resp.read() or b"{}")
+        with tracing.span("volume.write", fid=fid) as sp:
+            sp.n_bytes = len(data)
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read() or b"{}")
     except urllib.error.HTTPError as e:
         raise OperationError(
             f"upload to {url} failed: {e.code} {e.read()!r}") from e
@@ -91,9 +95,13 @@ def download(master: MasterClient, fid: str,
         url = f"http://{loc['url']}/{fid}"
         if collection:
             url += f"?collection={collection}"
+        req = urllib.request.Request(url, headers=tracing.inject({}))
         try:
-            with urllib.request.urlopen(url, timeout=60) as resp:
-                return resp.read()
+            with tracing.span("volume.read", fid=fid) as sp:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    data = resp.read()
+                sp.n_bytes = len(data)
+                return data
         except urllib.error.URLError as e:
             last = e
     raise OperationError(f"download {fid} failed: {last}")
@@ -106,7 +114,8 @@ def delete(master: MasterClient, fid: str, jwt: str = "",
         url = f"http://{loc['url']}/{fid}"
         if collection:
             url += f"?collection={collection}"
-        req = urllib.request.Request(url, method="DELETE")
+        req = urllib.request.Request(
+            url, method="DELETE", headers=tracing.inject({}))
         if jwt:
             req.add_header("Authorization", f"BEARER {jwt}")
         try:

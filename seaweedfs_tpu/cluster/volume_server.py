@@ -38,8 +38,8 @@ from ..storage.store import Store, StoreError
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
-from ..util import glog, security
-from ..util.stats import Metrics
+from ..util import glog, security, tracing
+from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from .master import _grpc_port
 from ..util import tls as tls_mod
 
@@ -399,8 +399,10 @@ class VolumeServer:
                    collection: str = "") -> bytes:
         """GET path: normal volume first, then mounted EC shards."""
         if self.store.has_volume(volume_id, collection):
-            n = self.store.read_needle(volume_id, fid.key, fid.cookie,
-                                       collection)
+            with tracing.span("store.read_needle", vid=volume_id) as sp:
+                n = self.store.read_needle(volume_id, fid.key,
+                                           fid.cookie, collection)
+                sp.n_bytes = len(n.data)
             return n.data
         ckey = self._ec_cache_key(volume_id, fid)
         cached = self.chunk_cache.get(ckey)
@@ -415,9 +417,12 @@ class VolumeServer:
                     break
         if mount is None:
             raise StoreError(f"volume {volume_id} not found")
-        reader = ClusterEcReader(self, volume_id, mount.base,
-                                 _scheme_from_vif(mount.base))
-        n = reader.read_needle(fid.key, fid.cookie)
+        with tracing.span("ec.reconstruct", vid=volume_id) as sp:
+            reader = ClusterEcReader(self, volume_id, mount.base,
+                                     _scheme_from_vif(mount.base))
+            n = reader.read_needle(fid.key, fid.cookie)
+            sp.n_bytes = len(n.data)
+            sp.tag(intervals_repaired=reader.intervals_repaired)
         self.metrics.counter("ec_intervals_repaired").inc(
             reader.intervals_repaired)
         self.chunk_cache.put(ckey, n.data, volume=volume_id)
@@ -943,8 +948,14 @@ def _make_http_handler(vs: VolumeServer):
                             **vs.store.status()})
                 return
             if u.path == "/metrics":
-                self._send(200, vs.metrics.render().encode(),
-                           "text/plain")
+                self._send(200, (vs.metrics.render()
+                                 + tracing.METRICS.render()).encode(),
+                           EXPOSITION_CONTENT_TYPE)
+                return
+            if u.path == "/debug/traces":
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                self._json(tracing.debug_payload(
+                    int(q["limit"]) if "limit" in q else None))
                 return
             t0 = time.perf_counter()
             try:
@@ -1048,7 +1059,7 @@ def _make_http_handler(vs: VolumeServer):
             except Exception as e:
                 self._json({"error": str(e)}, 500)
 
-    return Handler
+    return tracing.instrument_http_handler(Handler, "volume")
 
 
 def _replicate_http(peer_url: str, fid: str, body: Optional[bytes],
@@ -1099,6 +1110,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
     tls_mod.install_from_config(conf)
+    tracing.configure_from(conf)
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,
                   needle_map=args.index)
     store.load_existing()

@@ -17,6 +17,7 @@ from ..cache import METRICS as _cache_metrics
 from ..pb import master_pb2
 from .master import _grpc_port
 from ..util import tls as tls_mod
+from ..util import tracing
 
 _LEADER_RE = re.compile(r"leader is ([0-9A-Za-z_.-]+:\d+)")
 
@@ -104,7 +105,9 @@ class MasterClient:
             hit = self._vid_map.get(volume_id)
             if hit and now - hit[0] < self.cache_seconds:
                 _cache_metrics.counter("cache_hits", tier="vidmap").inc()
-                return hit[1]
+                with tracing.span("master.lookup", vid=volume_id,
+                                  cached="true"):
+                    return hit[1]
         _cache_metrics.counter("cache_misses", tier="vidmap").inc()
         def call():
             resp = self._stub().LookupVolume(
@@ -116,7 +119,9 @@ class MasterClient:
                     raise RuntimeError(entry.error)
             return resp
 
-        resp = self._with_failover(call)
+        with tracing.span("master.lookup", vid=volume_id,
+                          cached="false"):
+            resp = self._with_failover(call)
         locs: list[dict] = []
         for entry in resp.volume_id_locations:
             if entry.error:
@@ -143,7 +148,8 @@ class MasterClient:
                 raise RuntimeError(resp.error)
             return resp
 
-        resp = self._with_failover(call)
+        with tracing.span("master.assign"):
+            resp = self._with_failover(call)
         return {"fid": resp.fid, "url": resp.url,
                 "publicUrl": resp.public_url, "count": resp.count,
                 "auth": resp.auth}

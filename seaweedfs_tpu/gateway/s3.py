@@ -28,6 +28,7 @@ from typing import Optional
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
 from ..util import glog
+from ..util import tracing
 from ..util.stats import Metrics
 from .s3_auth import AuthError, Identity, SigV4Verifier
 
@@ -700,7 +701,7 @@ def _make_handler(gw: S3Gateway):
             except Exception as e:
                 self._fail(e)
 
-    return Handler
+    return tracing.instrument_http_handler(Handler, "s3")
 
 
 def parse_identities(cfg: dict) -> list[Identity]:

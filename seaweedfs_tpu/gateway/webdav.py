@@ -17,6 +17,7 @@ from typing import Optional
 from ..cache import global_chunk_cache
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..util import glog
+from ..util import tracing
 
 DAV_NS = "DAV:"
 
@@ -275,7 +276,7 @@ def _make_handler(dav: WebDavServer):
                 return
             self._send(201)
 
-    return Handler
+    return tracing.instrument_http_handler(Handler, "dav")
 
 
 def main(argv: list[str]) -> int:

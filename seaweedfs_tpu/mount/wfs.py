@@ -31,6 +31,7 @@ from ..cluster import operation
 from ..cluster.filer_client import FilerClient
 from ..cluster.wdclient import MasterClient
 from ..pb import filer_pb2
+from ..util import tracing
 from .file_handle import ChunkCache, FileHandle
 
 
@@ -102,6 +103,7 @@ class WFS:
 
     # ------------- fuse-op surface -------------
 
+    @tracing.traced("wfs.getattr")
     def getattr(self, path: str) -> dict:
         e = self._lookup(path)
         if e is None:
@@ -122,6 +124,7 @@ class WFS:
                 "st_uid": e.attributes.uid, "st_gid": e.attributes.gid,
                 "st_nlink": 2 if e.is_directory else 1}
 
+    @tracing.traced("wfs.readdir")
     def readdir(self, path: str) -> Iterator[str]:
         d = "/" + path.strip("/")
         if self._lookup(path) is None and d != "/":
@@ -129,6 +132,7 @@ class WFS:
         for e in self.filer.list(d):
             yield e.name
 
+    @tracing.traced("wfs.mkdir")
     def mkdir(self, path: str, mode: int = 0o755) -> None:
         d, n = _split(path)
         if not n:
@@ -138,6 +142,7 @@ class WFS:
         e.attributes.crtime = int(time.time())
         self.filer.create(d, e)
 
+    @tracing.traced("wfs.rmdir")
     def rmdir(self, path: str) -> None:
         e = self._lookup(path)
         if e is None:
@@ -149,6 +154,7 @@ class WFS:
         d, n = _split(path)
         self.filer.delete(d, n, recursive=False, delete_data=False)
 
+    @tracing.traced("wfs.create")
     def create(self, path: str, mode: int = 0o644, flags: int = 0) -> int:
         d, n = _split(path)
         e = filer_pb2.Entry(name=n, is_directory=False)
@@ -158,6 +164,7 @@ class WFS:
         self.filer.create(d, e)
         return self.open(path, flags | os.O_CREAT)
 
+    @tracing.traced("wfs.open")
     def open(self, path: str, flags: int = 0) -> int:
         e = self._lookup(path)
         if e is None:
@@ -183,18 +190,22 @@ class WFS:
             raise FuseError(errno.EBADF, str(fh))
         return h
 
+    @tracing.traced("wfs.read")
     def read(self, fh: int, offset: int, length: int) -> bytes:
         return self._handle(fh).read(offset, length)
 
+    @tracing.traced("wfs.write")
     def write(self, fh: int, offset: int, data: bytes) -> int:
         return self._handle(fh).write(offset, data)
 
+    @tracing.traced("wfs.flush")
     def flush(self, fh: int) -> None:
         self._handle(fh).flush()
 
     def truncate_fh(self, fh: int, size: int) -> None:
         self._handle(fh).truncate(size)
 
+    @tracing.traced("wfs.truncate")
     def truncate(self, path: str, size: int) -> None:
         fh = self.open(path)
         try:
@@ -202,12 +213,14 @@ class WFS:
         finally:
             self.release(fh)
 
+    @tracing.traced("wfs.release")
     def release(self, fh: int) -> None:
         with self._lock:
             h = self._handles.pop(fh, None)
         if h is not None:
             h.release()
 
+    @tracing.traced("wfs.unlink")
     def unlink(self, path: str) -> None:
         e = self._lookup(path)
         if e is None:
@@ -219,6 +232,7 @@ class WFS:
         for c in e.chunks:
             self.chunk_cache.invalidate(c.file_id)
 
+    @tracing.traced("wfs.rename")
     def rename(self, old: str, new: str) -> None:
         if self._lookup(old) is None:
             raise FuseError(errno.ENOENT, old)
@@ -226,6 +240,7 @@ class WFS:
         nd, nn = _split(new)
         self.filer.rename(od, on, nd, nn)
 
+    @tracing.traced("wfs.chmod")
     def chmod(self, path: str, mode: int) -> None:
         e = self._lookup(path)
         if e is None:

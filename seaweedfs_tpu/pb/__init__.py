@@ -154,16 +154,20 @@ def generic_handler(service_name: str, methods: list[Method],
     """
     import grpc
 
+    from ..util import tracing
+
     handlers: dict[str, object] = {}
     for m in methods:
         fn: Callable = getattr(servicer, m.name)
         if m.kind == UNARY:
             handlers[m.name] = grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=m.request_cls.FromString,
+                tracing.wrap_grpc_unary(fn, m.name),
+                request_deserializer=m.request_cls.FromString,
                 response_serializer=m.response_cls.SerializeToString)
         elif m.kind == SERVER_STREAM:
             handlers[m.name] = grpc.unary_stream_rpc_method_handler(
-                fn, request_deserializer=m.request_cls.FromString,
+                tracing.wrap_grpc_stream(fn, m.name),
+                request_deserializer=m.request_cls.FromString,
                 response_serializer=m.response_cls.SerializeToString)
         elif m.kind == BIDI_STREAM:
             handlers[m.name] = grpc.stream_stream_rpc_method_handler(

@@ -121,6 +121,10 @@ def write_idx_file_from_ecx(base: str | Path) -> int:
 def decode_volume(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME
                   ) -> int:
     """Full ec.decode: .dat + .idx restored; returns the .dat size."""
-    size = write_dat_file(base, scheme)
+    from ..util import tracing
+
+    with tracing.span("ec.decode", base=str(base)) as sp:
+        size = write_dat_file(base, scheme)
+        sp.n_bytes = size
     write_idx_file_from_ecx(base)
     return size

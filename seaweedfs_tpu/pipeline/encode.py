@@ -125,9 +125,13 @@ def encode_volume(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     way `ec.encode` deletes the source volume after spreading shards).
     The .vif records the volume's actual needle version (from the
     superblock) so readers and decode parse records correctly."""
+    from ..util import tracing
+
     with open(_require_local_dat(base), "rb") as f:
         version = superblock_mod.SuperBlock.parse(f.read(8)).version
-    dat_size = write_ec_files(base, scheme, max_batch_bytes)
+    with tracing.span("ec.encode", base=str(base)) as sp:
+        dat_size = write_ec_files(base, scheme, max_batch_bytes)
+        sp.n_bytes = dat_size
     write_ecx_file(base)
     vi = ec_files.VolumeInfo(version=version, replication=replication,
                              dat_file_size=dat_size,

@@ -88,13 +88,19 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         for row, f in zip(rebuilt[0], outs):
             row.tofile(f)
 
+    from ..util import tracing
+
     try:
         # pipelined like encode: shard reads, device reconstruct and
         # shard writes overlap, and on a single accelerator several
         # chunks share one dispatch (the same grouped word-form path
         # the encoder uses — see pipe.run_pipeline).
-        pipe.run_pipeline(chunks(), reconstruct, write,
-                          encode_multi_fn=reconstruct_multi, group=group)
+        with tracing.span("ec.rebuild", base=str(base)) as sp:
+            sp.n_bytes = size * len(missing)
+            sp.tag(shards=",".join(str(i) for i in missing))
+            pipe.run_pipeline(chunks(), reconstruct, write,
+                              encode_multi_fn=reconstruct_multi,
+                              group=group)
     finally:
         for f in ins + outs:
             f.close()

@@ -1703,6 +1703,85 @@ def cmd_unlock(env: ClusterEnv, argv: list[str]) -> None:
     env.println("unlocked")
 
 
+def _trace_hosts(env: ClusterEnv) -> list[tuple[str, str]]:
+    """(role, host) pairs whose /debug/traces we can poll: the master,
+    every data node in its topology, and the filer when configured."""
+    hosts = [("master", env.master_url)]
+    try:
+        for node in env.collect_ec_nodes():
+            hosts.append(("volume", node.url))
+    except Exception:  # noqa: BLE001 — master down; report what we can
+        pass
+    if env.filer_url:
+        hosts.append(("filer", env.filer_url))
+    return hosts
+
+
+@cluster_command("trace.status")
+def cmd_trace_status(env: ClusterEnv, argv: list[str]) -> None:
+    """Per-server tracing state: ring occupancy and config, polled from
+    each server's /debug/traces endpoint."""
+    p = _parser("trace.status")
+    p.parse_args(argv)
+    for role, host in _trace_hosts(env):
+        try:
+            d = env._master_http("/debug/traces?limit=0", host=host)
+        except ShellError as e:
+            env.println(f"{role} {host}: unreachable ({e})")
+            continue
+        env.println(f"{role} {host}: enabled={d['enabled']} "
+                    f"ring={d['count']}/{d['ring_size']} "
+                    f"slow_threshold={d['slow_threshold_seconds']}s")
+
+
+@cluster_command("trace.dump")
+def cmd_trace_dump(env: ClusterEnv, argv: list[str]) -> None:
+    """Span trees of recent traces across the cluster. With -traceId,
+    stitches that trace's spans from every server into one tree."""
+    from ..util import tracing
+
+    p = _parser("trace.dump")
+    p.add_argument("-n", type=int, default=1,
+                   help="recent traces per server (without -traceId)")
+    p.add_argument("-traceId", default="")
+    args = p.parse_args(argv)
+    found = False
+    if args.traceId:
+        # One logical trace leaves partial span sets on several
+        # processes; merge them before rendering the tree. The header
+        # line comes from the ingress piece: the one with no remote
+        # parent, or — when the caller supplied a parent span id, so
+        # every piece has one — the piece that started first.
+        pieces: list[dict] = []
+        for _, host in _trace_hosts(env):
+            try:
+                d = env._master_http("/debug/traces", host=host)
+            except ShellError:
+                continue
+            pieces.extend(t for t in d["traces"]
+                          if t["trace_id"] == args.traceId)
+        if pieces:
+            root = min(pieces, key=lambda t: (t["remote_parent"] != "",
+                                              t["start"]))
+            spans = [s for t in pieces for s in t["spans"]]
+            merged = dict(root, spans=spans, span_count=len(spans))
+            env.println(tracing.render_trace(merged))
+            found = True
+    else:
+        for role, host in _trace_hosts(env):
+            try:
+                d = env._master_http(f"/debug/traces?limit={args.n}",
+                                     host=host)
+            except ShellError:
+                continue
+            for t in d["traces"]:
+                env.println(f"[{role} {host}]")
+                env.println(tracing.render_trace(t))
+                found = True
+    if not found:
+        env.println("trace.dump: no completed traces")
+
+
 def run_cluster_command(env: ClusterEnv, line: str) -> None:
     parts = shlex.split(line)
     if not parts:

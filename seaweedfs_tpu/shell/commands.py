@@ -406,6 +406,48 @@ def cmd_cache_clear(env: CommandEnv, argv: list[str]) -> None:
     env.println(f"cache.clear: dropped {dropped} entries")
 
 
+@command("trace.status")
+def cmd_trace_status(env: CommandEnv, argv: list[str]) -> None:
+    """Tracing config + ring-buffer occupancy + per-stage span counts
+    of this process (docs/observability.md)."""
+    p = _parser("trace.status")
+    p.parse_args(argv)
+    from ..util import tracing
+    payload = tracing.debug_payload()
+    env.println(f"trace.status enabled={payload['enabled']} "
+                f"ring={payload['count']}/{payload['ring_size']} "
+                f"slow_threshold="
+                f"{payload['slow_threshold_seconds']}s")
+    stages: dict[str, int] = {}
+    for t in payload["traces"]:
+        for s in t["spans"]:
+            stages[s["name"]] = stages.get(s["name"], 0) + 1
+    for name in sorted(stages):
+        env.println(f"  {name}: {stages[name]} spans")
+
+
+@command("trace.dump")
+def cmd_trace_dump(env: CommandEnv, argv: list[str]) -> None:
+    """Span trees of the most recent completed traces."""
+    p = _parser("trace.dump")
+    p.add_argument("-n", type=int, default=3,
+                   help="how many recent traces to print")
+    p.add_argument("-traceId", default="",
+                   help="dump one specific trace id")
+    args = p.parse_args(argv)
+    from ..util import tracing
+    traces = tracing.recent_traces()
+    if args.traceId:
+        traces = [t for t in traces if t["trace_id"] == args.traceId]
+    else:
+        traces = traces[-max(0, args.n):]
+    if not traces:
+        env.println("trace.dump: no completed traces")
+        return
+    for t in traces:
+        env.println(tracing.render_trace(t))
+
+
 def run_command(env: CommandEnv, line: str) -> None:
     """Parse and run one shell line."""
     parts = shlex.split(line)
@@ -419,8 +461,10 @@ def run_command(env: CommandEnv, line: str) -> None:
     fn = COMMANDS.get(name)
     if fn is None:
         raise ShellError(f"unknown command {name!r} (try 'help')")
+    from ..util import tracing
     try:
-        fn(env, argv)
+        with tracing.start_trace(f"shell.{name}"):
+            fn(env, argv)
     except ShellError:
         raise
     except (argparse.ArgumentError, SystemExit) as e:

@@ -56,6 +56,13 @@ dir = ""                         # empty disables the on-disk tier
 capacity_bytes = 268435456       # 256 MiB across all segment files
 segments = 4
 """,
+    "tracing": """\
+# tracing.toml — end-to-end request tracing (docs/observability.md).
+[tracing]
+enabled = true                   # false strips all span bookkeeping
+ring_size = 256                  # completed traces kept per process
+slow_threshold_seconds = 1.0     # slower roots log a span-tree line
+""",
 }
 
 

@@ -15,11 +15,21 @@ from typing import Iterable
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: Content-Type servers must send on ``/metrics`` responses.
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def _escape_label_value(v: str) -> str:
+    # Exposition-format escaping: backslash first, then quote/newline.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
 
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -109,7 +119,7 @@ class Metrics:
                 cum = 0
                 for b, c in zip(m.buckets, m.counts):
                     cum += c
-                    le = dict(labels); le["le"] = repr(b)
+                    le = dict(labels); le["le"] = "%g" % b
                     lines.append(f"{full}_bucket{_fmt_labels(le)} {cum}")
                 le = dict(labels); le["le"] = "+Inf"
                 lines.append(
@@ -152,6 +162,9 @@ class MetricsPusher:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+        # Final best-effort push so metrics from the last interval
+        # aren't lost at shutdown.
+        self.push_once()
 
     def push_once(self) -> bool:
         import urllib.request

@@ -111,13 +111,18 @@ def install_from_flag(args) -> None:
 
 
 def dial(target: str, options=None):
-    """Open a gRPC channel honoring the installed TLS config."""
+    """Open a gRPC channel honoring the installed TLS config. Every
+    channel carries the active trace context in call metadata."""
     import grpc
+
+    from . import tracing
     cfg = _INSTALLED
     if cfg is None:
-        return grpc.insecure_channel(target, options=options)
-    return grpc.secure_channel(target, cfg.channel_credentials(),
-                               options=options)
+        channel = grpc.insecure_channel(target, options=options)
+    else:
+        channel = grpc.secure_channel(target, cfg.channel_credentials(),
+                                      options=options)
+    return tracing.grpc_trace_channel(channel)
 
 
 def serve_port(server, address: str) -> int:

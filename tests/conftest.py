@@ -31,3 +31,54 @@ os.environ["PYTHONPATH"] = os.pathsep.join(
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition-format mini parser (shared by metrics tests).
+# ---------------------------------------------------------------------------
+
+import re  # noqa: E402
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    return (v.replace("\\\\", "\x00").replace('\\"', '"')
+            .replace("\\n", "\n").replace("\x00", "\\"))
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse exposition text -> {name: [(labels_dict, float_value)]};
+    raises ValueError on any malformed line (that IS the test)."""
+    samples: dict = {}
+    types: dict = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed sample line: {line!r}")
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(raw):
+                labels[lm.group(1)] = _unescape_label(lm.group(2))
+                consumed = lm.end()
+            # everything between matches must be commas only
+            leftovers = _LABEL_RE.sub("", raw).replace(",", "").strip()
+            if leftovers or consumed != len(raw):
+                raise ValueError(f"malformed labels: {raw!r}")
+        v = m.group("value")
+        value = float("inf") if v == "+Inf" else float(v)
+        samples.setdefault(m.group("name"), []).append((labels, value))
+    parse_exposition.last_types = types
+    return samples

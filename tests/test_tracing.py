@@ -1,0 +1,237 @@
+"""Tracing subsystem + Prometheus exposition-format round-trips.
+
+The exposition checks use the mini line-format parser in
+``tests/conftest.py`` — anything ``Metrics.render()`` emits must parse,
+unescape back to the original label values, and keep histogram buckets
+cumulative/monotone with ``+Inf`` equal to ``_count``.
+"""
+
+import math
+import time
+
+import pytest
+from conftest import parse_exposition
+
+from seaweedfs_tpu.util import stats, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.configure(enabled=True, ring_size=256,
+                      slow_threshold_seconds=1.0)
+    tracing.reset()
+    yield
+    tracing.configure(enabled=True, ring_size=256,
+                      slow_threshold_seconds=1.0)
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# exposition format
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_round_trip():
+    m = stats.Metrics(namespace="t")
+    m.counter("reqs_total", method="GET", code="200").inc(3)
+    m.gauge("queue_depth", shard="a").set(7.5)
+    samples = parse_exposition(m.render())
+    assert samples["t_reqs_total"] == [
+        ({"method": "GET", "code": "200"}, 3.0)]
+    assert samples["t_queue_depth"] == [({"shard": "a"}, 7.5)]
+    assert parse_exposition.last_types["t_reqs_total"] == "counter"
+    assert parse_exposition.last_types["t_queue_depth"] == "gauge"
+
+
+def test_label_escaping_round_trip():
+    m = stats.Metrics(namespace="t")
+    nasty = 'a"b\\c\nd'
+    m.counter("odd_total", path=nasty).inc()
+    text = m.render()
+    # escaped on the wire: backslash first, then quote, then newline
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    samples = parse_exposition(text)
+    (labels, value), = samples["t_odd_total"]
+    assert labels["path"] == nasty
+    assert value == 1.0
+
+
+def test_histogram_round_trip_and_monotonicity():
+    m = stats.Metrics(namespace="t")
+    h = m.histogram("lat_seconds", op="read")
+    for v in (0.0001, 0.003, 0.003, 0.2, 9.0, 100.0):
+        h.observe(v)
+    samples = parse_exposition(m.render())
+    buckets = samples["t_lat_seconds_bucket"]
+    # le labels are %g-formatted: integral bounds have no trailing ".0"
+    les = [b[0]["le"] for b in buckets]
+    assert "1" in les and "1.0" not in les
+    assert les[-1] == "+Inf"
+    counts = [b[1] for b in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == samples["t_lat_seconds_count"][0][1] == 6.0
+    assert math.isclose(samples["t_lat_seconds_sum"][0][1],
+                        0.0001 + 0.003 + 0.003 + 0.2 + 9.0 + 100.0)
+    # parser maps +Inf to float inf on the label, but its *value* is n
+    assert buckets[-1][0]["le"] == "+Inf"
+
+
+def test_trace_metrics_registry_renders_valid_exposition():
+    with tracing.start_trace("unit.root"):
+        with tracing.span("unit.child") as sp:
+            sp.n_bytes = 42
+    samples = parse_exposition(tracing.METRICS.render())
+    stages = {lb["stage"] for lb, _ in
+              samples["trace_request_stage_seconds_count"]}
+    assert {"unit.root", "unit.child"} <= stages
+    assert any(lb == {"stage": "unit.child"} and v == 42.0
+               for lb, v in samples["trace_stage_bytes_total"])
+
+
+def test_pusher_final_push_on_stop():
+    m = stats.Metrics(namespace="t")
+    m.counter("x_total").inc()
+    # port 1 is never listening — every push attempt lands in .errors
+    p = stats.MetricsPusher(m, "127.0.0.1:1", "job", "i",
+                            interval_seconds=60.0)
+    p.stop()  # never started: only the final best-effort push runs
+    assert p.errors == 1 and p.pushed == 0
+
+
+# ---------------------------------------------------------------------------
+# span lifecycle
+# ---------------------------------------------------------------------------
+
+def test_nested_spans_bundle_into_one_trace():
+    with tracing.start_trace("root", path="/x") as root:
+        with tracing.span("mid") as mid:
+            with tracing.span("leaf") as leaf:
+                leaf.n_bytes = 10
+        assert tracing.active()
+    traces = tracing.recent_traces()
+    assert len(traces) == 1
+    t = traces[0]
+    assert t["name"] == "root" and t["span_count"] == 3
+    assert t["trace_id"] == root.trace_id
+    by_name = {s["name"]: s for s in t["spans"]}
+    assert by_name["mid"]["parent_id"] == root.span_id
+    assert by_name["leaf"]["parent_id"] == mid.span_id
+    assert by_name["leaf"]["bytes"] == 10
+    assert by_name["root"]["tags"] == {"path": "/x"}
+    assert not tracing.active()
+
+
+def test_span_outside_trace_is_noop():
+    with tracing.span("orphan") as sp:
+        sp.n_bytes = 5  # writes to the shared null span are discarded
+    assert sp is tracing._NULL_SPAN
+    assert tracing.recent_traces() == []
+
+
+def test_header_parse_and_inject_round_trip():
+    assert tracing.parse_value(None) == (None, "")
+    assert tracing.parse_value("nodash") == (None, "")
+    assert tracing.parse_value("abc-def") == ("abc", "def")
+    assert tracing.inject({}) == {}  # no active trace -> untouched
+    with tracing.start_trace("root", header="cafe1234-parent99") as sp:
+        assert sp.trace_id == "cafe1234"
+        assert sp.parent_id == "parent99"
+        hdr = tracing.inject({})
+        assert hdr[tracing.TRACE_HEADER] == f"cafe1234-{sp.span_id}"
+    t, = tracing.recent_traces()
+    assert t["trace_id"] == "cafe1234"
+    assert t["remote_parent"] == "parent99"
+
+
+def test_nested_start_trace_degrades_to_child_span():
+    with tracing.start_trace("outer") as outer:
+        with tracing.start_trace("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    t, = tracing.recent_traces()
+    assert t["span_count"] == 2
+
+
+def test_disabled_tracing_is_fully_inert():
+    tracing.configure(enabled=False)
+    with tracing.start_trace("root") as sp:
+        with tracing.span("child") as ch:
+            assert ch is tracing._NULL_SPAN
+        assert sp is tracing._NULL_SPAN
+        assert not tracing.active()
+    assert tracing.recent_traces() == []
+
+
+def test_exception_marks_span_error():
+    with pytest.raises(ValueError):
+        with tracing.start_trace("root"):
+            with tracing.span("bad"):
+                raise ValueError("boom")
+    t, = tracing.recent_traces()
+    by_name = {s["name"]: s for s in t["spans"]}
+    assert by_name["bad"]["status"] == "error:ValueError"
+    assert t["status"] == "error:ValueError"
+
+
+def test_ring_buffer_bounded_and_limits():
+    tracing.configure(ring_size=4)
+    for i in range(6):
+        with tracing.start_trace(f"t{i}"):
+            pass
+    traces = tracing.recent_traces()
+    assert [t["name"] for t in traces] == ["t2", "t3", "t4", "t5"]
+    assert tracing.recent_traces(limit=0) == []
+    assert [t["name"] for t in tracing.recent_traces(limit=2)] == \
+        ["t4", "t5"]
+    payload = tracing.debug_payload(limit=0)
+    assert payload["count"] == 4 and payload["traces"] == []
+    assert payload["ring_size"] == 4 and payload["enabled"] is True
+
+
+def test_slow_trace_logs_span_summary(monkeypatch):
+    logged = []
+    monkeypatch.setattr(tracing.glog, "warning",
+                        lambda fmt, *a: logged.append(fmt % a))
+    tracing.configure(slow_threshold_seconds=0.0)
+    with tracing.start_trace("slowroot"):
+        with tracing.span("step"):
+            time.sleep(0.001)
+    slow = [ln for ln in logged if ln.startswith("slow trace")]
+    assert len(slow) == 1
+    assert "slowroot" in slow[0] and "step" in slow[0]
+
+
+def test_summarize_and_render_tree_shapes():
+    with tracing.start_trace("root"):
+        with tracing.span("a") as sp:
+            sp.n_bytes = 7
+        with tracing.span("b"):
+            pass
+    t, = tracing.recent_traces()
+    line = tracing.summarize_spans(t["spans"])
+    assert line.startswith("root ")
+    assert "{a " in line and ",b " in line and "7B" in line
+    rendered = tracing.render_trace(t)
+    lines = rendered.splitlines()
+    assert lines[0].startswith(f"trace {t['trace_id']} root")
+    assert "(3 spans)" in lines[0]
+    # children indent one level deeper than the root span
+    assert any(ln.startswith("    a ") for ln in lines)
+
+
+def test_traced_decorator():
+    @tracing.traced("wfs.op", kind="unit")
+    def op(x):
+        return x * 2
+
+    assert op(21) == 42
+    t, = tracing.recent_traces()
+    assert t["name"] == "wfs.op"
+    assert t["spans"][0]["tags"] == {"kind": "unit"}
+
+
+def test_http_untraced_paths():
+    assert tracing._http_untraced("/metrics")
+    assert tracing._http_untraced("/debug/traces?limit=2")
+    assert tracing._http_untraced("/raft/vote")
+    assert not tracing._http_untraced("/b/obj")
+    assert not tracing._http_untraced("/dir/assign")
