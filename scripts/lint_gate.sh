@@ -1,0 +1,25 @@
+#!/bin/bash
+# CI gate for the seaweedlint static analyzer.
+#
+# Fails (non-zero) when the tree has any warning-or-worse finding that
+# is not in seaweedfs_tpu/analysis/baseline.json — i.e. only NEW
+# violations break the build; the inherited ones are pinned in the
+# baseline (each notable entry carries a justification) and burn down
+# over time. Fix the finding, or if it is a deliberate design, either
+# add an inline `# seaweedlint: disable=SWxxx — reason` pragma on/above
+# the flagged line or refresh the baseline with
+# `scripts/seaweedlint --write-baseline` and justify the new entry.
+#
+# docs/static_analysis.md has the rule catalog and workflow.
+set -u
+cd "$(dirname "$0")/.." || exit 2
+
+env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.analysis --gate warning
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: NEW analyzer findings above (exit $rc)." >&2
+    echo "lint_gate: fix them, pragma them with a reason, or" \
+         "re-baseline with scripts/seaweedlint --write-baseline" >&2
+fi
+exit "$rc"

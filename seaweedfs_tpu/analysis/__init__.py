@@ -1,0 +1,42 @@
+"""seaweedlint — project-native static analysis for seaweedfs_tpu.
+
+Go's SeaweedFS leans on ``go vet`` and the race detector; this package
+is the Python-side equivalent, specialized to the concurrency and
+resource idioms THIS codebase actually uses (30+ lock sites across
+cache/, cluster/, storage/, filer/, span and handle lifecycles, a
+Prometheus-text metrics registry). It is pure stdlib ``ast`` — no jax,
+no grpc — so it runs anywhere in milliseconds.
+
+Rule families (see docs/static_analysis.md for the catalog):
+
+- SW1xx  locks: a cross-module lock-acquisition graph built from
+  ``with <lock>:`` sites plus a resolved call graph; reports
+  lock-order cycles (SW101, error), nested-acquire sites (SW102,
+  info), and blocking I/O — sleep/socket/RPC/subprocess (error) or
+  file I/O (warning) — performed while a lock is held (SW103).
+- SW2xx  resources: files/sockets/channels opened without ``with`` /
+  ``finally`` closure (SW201), tracing spans not context-managed
+  (SW202).
+- SW3xx  exceptions: handlers that swallow silently — ``pass`` with no
+  logging (SW301; error in server/heartbeat loops, else warning),
+  bare ``except:`` (SW302, error).
+- SW4xx  metrics: unbounded label cardinality at util/stats call
+  sites — f-string / ``str()`` / %-format label values (SW401, error),
+  variable label values and dynamic metric names (SW402, info).
+
+Findings diff against a checked-in baseline
+(``seaweedfs_tpu/analysis/baseline.json``) so CI fails only on NEW
+violations; inline ``# seaweedlint: disable=SW103 — reason`` pragmas
+suppress deliberate sites at the line.
+
+Run: ``python -m seaweedfs_tpu.analysis`` (alias ``scripts/seaweedlint``),
+gate: ``scripts/lint_gate.sh``.
+
+The runtime complement — a lock-order *recorder* that watches real
+acquisitions under ``SEAWEED_LOCKCHECK=1`` — lives in
+``seaweedfs_tpu/util/lockcheck.py``.
+"""
+
+from .findings import Finding, SEVERITIES  # noqa: F401
+from .engine import analyze_paths, analyze_sources  # noqa: F401
+from .baseline import load_baseline, write_baseline, diff_baseline  # noqa: F401

@@ -1,0 +1,106 @@
+"""seaweedlint CLI.
+
+    python -m seaweedfs_tpu.analysis [paths...]
+        [--baseline FILE | --no-baseline] [--write-baseline]
+        [--gate error|warning|none] [--json] [--verbose]
+
+Exit codes: 0 clean (or all findings baselined), 1 new findings at or
+above the gate severity, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .baseline import diff_baseline, load_baseline, write_baseline
+from .engine import analyze_paths
+from .findings import SEVERITIES, Finding
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _summarize(findings: list[Finding]) -> str:
+    by = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        by[f.severity] += 1
+    return (f"{len(findings)} finding(s): {by['error']} error, "
+            f"{by['warning']} warning, {by['info']} info")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="seaweedlint",
+        description="project-native concurrency & resource analyzer")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze "
+                         "(default: seaweedfs_tpu)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default {_DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(preserves justifications)")
+    ap.add_argument("--gate", choices=["error", "warning", "none"],
+                    default="warning",
+                    help="fail on new findings at/above this severity "
+                         "(default: warning)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print info-level findings")
+    args = ap.parse_args(argv)
+
+    root = _REPO_ROOT
+    paths = args.paths or ["seaweedfs_tpu"]
+    findings = analyze_paths(paths, root)
+
+    baseline_path = args.baseline or _DEFAULT_BASELINE
+    if args.write_baseline:
+        prev = load_baseline(baseline_path)
+        gated = [f for f in findings if f.severity != "info"]
+        write_baseline(baseline_path, gated, prev)
+        print(f"wrote {len(gated)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        baseline = load_baseline(baseline_path)
+        new, stale = diff_baseline(
+            [f for f in findings if f.severity != "info"], baseline)
+        new = new + [f for f in findings if f.severity == "info"]
+
+    gate_rank = {"none": len(SEVERITIES), "warning": 1, "error": 2}
+    threshold = gate_rank[args.gate]
+    gating = [f for f in new
+              if SEVERITIES.index(f.severity) >= threshold]
+    shown = [f for f in new
+             if args.verbose or f.severity != "info"]
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in shown],
+            "gating": len(gating),
+            "stale_baseline": stale,
+            "summary": _summarize(findings),
+        }, indent=1))
+    else:
+        for f in shown:
+            print(f.format())
+        if stale:
+            print(f"note: {len(stale)} baseline entr"
+                  f"{'y is' if len(stale) == 1 else 'ies are'} stale "
+                  f"(fixed) — run --write-baseline to prune")
+        print(f"seaweedlint: {_summarize(findings)}; "
+              f"{len(gating)} new at gate severity "
+              f"'{args.gate}'")
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

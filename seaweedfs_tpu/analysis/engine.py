@@ -1,0 +1,98 @@
+"""Analysis driver: walk files -> per-module models -> findings."""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .findings import Finding, fingerprint_findings, is_suppressed
+from .local_rules import check_local
+from .lockgraph import analyze_locks
+from .model import ModuleInfo, collect_module
+
+#: Generated / vendored files the rules should not police.
+_EXCLUDE_PARTS = {"__pycache__"}
+_EXCLUDE_SUFFIXES = ("_pb2.py",)
+
+
+def discover_files(paths: list[str], root: Path) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    out = []
+    for f in files:
+        if set(f.parts) & _EXCLUDE_PARTS:
+            continue
+        if f.name.endswith(_EXCLUDE_SUFFIXES):
+            continue
+        out.append(f)
+    return out
+
+
+def module_name_for(path: Path, root: Path) -> str:
+    try:
+        rel = path.resolve().relative_to(root.resolve())
+    except ValueError:
+        return path.stem
+    parts = list(rel.parts)
+    parts[-1] = parts[-1][:-3]  # .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1] or [path.parent.name]
+    return ".".join(parts)
+
+
+def analyze_sources(sources: dict[str, str],
+                    module_names: dict[str, str] | None = None
+                    ) -> list[Finding]:
+    """Analyze {repo-relative path: source text}. The unit the tests
+    drive: no filesystem involved."""
+    modules: dict[str, ModuleInfo] = {}
+    findings: list[Finding] = []
+    for path, src in sorted(sources.items()):
+        name = (module_names or {}).get(path) or \
+            path[:-3].replace("/", ".")
+        try:
+            modules[name] = collect_module(name, path, src)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "SW001", "error", path, e.lineno or 1, f"{name}:<module>",
+                f"syntax error: {e.msg}"))
+    for mi in modules.values():
+        findings.extend(check_local(mi))
+    findings.extend(analyze_locks(modules))
+
+    findings = [
+        f for f in findings
+        if not is_suppressed(f, sources,
+                             tuple(f.extra.get("anchors", ())))]
+    fingerprint_findings(findings, sources)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def analyze_paths(paths: list[str], root: Path) -> list[Finding]:
+    files = discover_files(paths, root)
+    sources: dict[str, str] = {}
+    names: dict[str, str] = {}
+    for f in files:
+        try:
+            rel = str(f.resolve().relative_to(root.resolve()))
+        except ValueError:
+            rel = str(f)
+        sources[rel] = f.read_text(encoding="utf-8",
+                                   errors="replace")
+        names[rel] = module_name_for(f, root)
+    return analyze_sources(sources, names)
+
+
+def parse_ok(source: str) -> bool:
+    """Cheap helper for tests."""
+    try:
+        ast.parse(source)
+        return True
+    except SyntaxError:
+        return False

@@ -1,0 +1,90 @@
+"""Finding record + stable fingerprints + inline pragma parsing."""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+
+#: Ranked severities; the gate fails on anything >= its threshold.
+SEVERITIES = ("info", "warning", "error")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*seaweedlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*[—-]|$)")
+
+
+@dataclass
+class Finding:
+    rule: str          # e.g. "SW103"
+    severity: str      # "error" | "warning" | "info"
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    qualname: str      # "module:Class.func" or "module:<module>"
+    message: str
+    fingerprint: str = ""
+    extra: dict = field(default_factory=dict)
+
+    def sort_key(self):
+        return (-SEVERITIES.index(self.severity), self.path, self.line,
+                self.rule)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {"fingerprint": self.fingerprint, "rule": self.rule,
+                "severity": self.severity, "path": self.path,
+                "line": self.line, "qualname": self.qualname,
+                "message": self.message}
+
+
+def fingerprint_findings(findings: list[Finding],
+                         sources: dict[str, str]) -> None:
+    """Assign line-drift-stable fingerprints in place.
+
+    Hash (rule, path, qualname, normalized source text of the flagged
+    line) — NOT the line number, so inserting code above a finding does
+    not churn the baseline. Identical lines in the same function get an
+    occurrence index so two real violations never collapse into one
+    baseline entry.
+    """
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        lines = sources.get(f.path, "").splitlines()
+        src = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        base = (f.rule, f.path, f.qualname, src)
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        raw = "|".join((*base[:3], src, str(n)))
+        f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def suppressed_rules(source_line: str) -> set[str]:
+    """Rules disabled by an inline pragma on this source line.
+
+    ``# seaweedlint: disable=SW103,SW201 — holding the cache lock over
+    the disk tier is the design``  →  {"SW103", "SW201"}; ``disable=all``
+    suppresses every rule on the line.
+    """
+    m = _PRAGMA_RE.search(source_line)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def is_suppressed(finding: Finding, sources: dict[str, str],
+                  anchor_lines: tuple[int, ...] = ()) -> bool:
+    """A pragma suppresses on the flagged line, the line above it, or
+    any anchor line (e.g. the ``with <lock>:`` statement a blocking
+    call was found under) and the line above that."""
+    lines = sources.get(finding.path, "").splitlines()
+    candidates = []
+    for ln in (finding.line, *anchor_lines):
+        candidates.extend((ln, ln - 1))
+    for ln in candidates:
+        if 0 < ln <= len(lines):
+            rules = suppressed_rules(lines[ln - 1])
+            if "all" in rules or finding.rule in rules:
+                return True
+    return False

@@ -391,6 +391,11 @@ class MasterServer:
         """Allocate one new volume on replica-placement-chosen nodes."""
         self._require_leader()
         replication = replication or self.default_replication
+        # Growth is deliberately serialized END TO END under this lock:
+        # the raft id-replication and the AllocateVolume rpcs must
+        # complete before a second grow may observe topology, or two
+        # volumes could land on one id.
+        # seaweedlint: disable=SW103 — intentional rpc under grow lock
         with self._grow_lock:
             targets = self.topology.pick_grow_targets(replication)
             vid = self.topology.next_volume_id()

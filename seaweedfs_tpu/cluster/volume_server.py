@@ -337,8 +337,9 @@ class VolumeServer:
                 master_pb2.GetMasterConfigurationRequest(), timeout=5)
             if cfg.metrics_interval_seconds:
                 interval = float(cfg.metrics_interval_seconds)
-        except Exception:  # noqa: BLE001 — default cadence is fine
-            pass
+        except Exception as e:  # noqa: BLE001 — default cadence is fine
+            glog.v(1, "metrics interval query failed (%s); using "
+                      "default %gs", e, interval)
         from ..util.stats import MetricsPusher
         pusher = MetricsPusher(self.metrics, address, "volume_server",
                                self.url, interval).start()

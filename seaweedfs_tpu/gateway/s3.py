@@ -157,8 +157,8 @@ class S3Gateway:
                     note = resp.event_notification
                     if note.new_entry.name or note.old_entry.name:
                         self._load_filer_identities()
-            except Exception:  # noqa: BLE001 — filer restart etc.
-                pass
+            except Exception as e:  # noqa: BLE001 — filer restart etc.
+                glog.v(1, "s3 identity watch stream broke: %s", e)
             # stream ended (error OR clean server-side return): pause
             # before re-attaching so a lagging/shutting-down filer is
             # not hammered in a tight loop

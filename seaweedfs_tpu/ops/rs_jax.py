@@ -202,6 +202,9 @@ def _device_worth_it() -> bool:
             # the process lifetime
             if _link_gibps is None:
                 link = _measure_link_gibps()
+                # The probe may trigger the one-time native build; the
+                # calibrate lock exists to single-fly exactly that.
+                # seaweedlint: disable=SW103 — intentional build-once
                 _native_gibps = _measure_native_gibps()
                 _link_gibps = link
                 from ..util import glog

@@ -65,6 +65,8 @@ def _load():
     global _lib
     with _lib_lock:
         if _lib is None:
+            # This lock EXISTS to single-fly the one-time g++ build.
+            # seaweedlint: disable=SW103 — intentional build-once lock
             lib = ctypes.CDLL(str(_build()))
             lib.gf256_init.restype = None
             lib.gf256_simd_level.restype = ctypes.c_int

@@ -75,8 +75,9 @@ class _WalkHold:
                 if cancel_stream is not None:
                     try:
                         cancel_stream()
-                    except Exception:  # noqa: BLE001 — best effort
-                        pass
+                    except Exception as ce:  # noqa: BLE001 — best effort
+                        glog.v(1, "bootstrap stream cancel failed: %s",
+                               ce)
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="replicator-bootstrap")
@@ -230,8 +231,8 @@ class Replicator:
                 if self._channel is not None:
                     try:
                         self._channel.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as ce:  # noqa: BLE001
+                        glog.v(2, "stale channel close failed: %s", ce)
                     self._channel = None
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 5.0)

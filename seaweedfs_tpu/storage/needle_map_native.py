@@ -60,6 +60,8 @@ def _get_lib():
     global _lib
     with _lib_lock:
         if _lib is None:
+            # This lock EXISTS to single-fly the one-time g++ build.
+            # seaweedlint: disable=SW103 — intentional build-once lock
             lib = ctypes.CDLL(str(_build()))
             lib.nm_new.restype = ctypes.c_void_p
             lib.nm_new.argtypes = [ctypes.c_uint64]

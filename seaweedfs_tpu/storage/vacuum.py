@@ -152,6 +152,13 @@ def commit_compact(vol: Volume, state: CompactState) -> int:
     if not getattr(vol, "vacuum_in_progress", False):
         raise VolumeError(
             f"volume {vol.volume_id}: no compaction in progress")
+    if vol.needle_map_kind == "native":
+        # Warm the native needle-map library BEFORE draining readers:
+        # its first use forks a g++ build, and paying that while
+        # holding the volume lock would stall every reader and writer
+        # on this volume for the length of a compile.
+        from . import needle_map_native
+        needle_map_native.available()
     with vol._lock:
         # Drain in-flight readers FIRST: Condition.wait releases the
         # volume lock, so waiting any later (after the diff replay)
@@ -163,6 +170,8 @@ def commit_compact(vol: Volume, state: CompactState) -> int:
         # a stream of overlapping reads cannot starve the drain.
         vol._swap_pending = True
         try:
+            # Any native-map build was pre-warmed above, outside the lock.
+            # seaweedlint: disable=SW103 — lib compile pre-warmed above
             size = _commit_swap_drained(vol, state)
         finally:
             vol._swap_pending = False

@@ -1,0 +1,256 @@
+"""Runtime lock-order recorder — the dynamic half of seaweedlint.
+
+ThreadSanitizer-style happens-before-order checking for locks, scoped
+to this project: under ``SEAWEED_LOCKCHECK=1`` the ``threading.Lock`` /
+``threading.RLock`` factories are wrapped so that every lock *created
+by seaweedfs_tpu code* (decided by the creator's module at allocation
+time — third-party and stdlib locks are never touched) is tracked.
+
+Each acquisition records edges "lock at site A was held while lock at
+site B was acquired" into one process-global order graph, keyed by the
+locks' CREATION SITES (file:line), not object ids — so two ChunkCache
+instances locked in opposite orders by two threads are reported as a
+potential deadlock even if no actual deadlock happened on this run,
+which is exactly the ordering discipline a single execution can check
+that a static analyzer cannot prove.
+
+An observed inversion (edge B→A recorded when A→B already exists) is a
+violation: always recorded (``violations()``), raised immediately as
+``LockOrderViolation`` under ``SEAWEED_LOCKCHECK=raise``. The tier-1
+suite enables record mode in tests/conftest.py and fails the session
+if any violation was observed (see ``pytest_sessionfinish`` there).
+
+Static counterpart: ``python -m seaweedfs_tpu.analysis`` (SW101/SW102).
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+import traceback
+from dataclasses import dataclass, field
+
+__all__ = ["install_from_env", "install", "uninstall", "enabled",
+           "violations", "reset", "LockOrderViolation", "TrackedLock",
+           "TRACKER"]
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+#: Wrap only locks allocated from these module prefixes.
+_SCOPE_PREFIXES = ("seaweedfs_tpu",)
+
+
+class LockOrderViolation(AssertionError):
+    """Two locks were observed acquired in both orders."""
+
+
+@dataclass
+class Violation:
+    first: str          # creation site of the lock acquired second
+    second: str         # creation site of the lock being acquired
+    thread: str
+    stack: str
+    prior_stack: str    # where the opposite order was recorded
+
+    def describe(self) -> str:
+        return (f"lock-order inversion: {self.second} acquired while "
+                f"holding {self.first}, but the opposite order was "
+                f"seen before.\n--- this acquisition "
+                f"({self.thread}):\n{self.stack}"
+                f"--- prior opposite-order site:\n{self.prior_stack}")
+
+
+def _short_stack(skip: int = 3, limit: int = 6) -> str:
+    frames = traceback.format_stack()[:-skip]
+    return "".join(frames[-limit:])
+
+
+@dataclass
+class _Tracker:
+    #: (site_held, site_acquired) -> stack where first recorded
+    edges: dict = field(default_factory=dict)
+    violations_list: list = field(default_factory=list)
+    raise_on_violation: bool = False
+
+    def __post_init__(self):
+        # raw C lock: the tracker must never recurse into itself
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = []
+            self._tls.held = h
+        return h
+
+    def on_acquired(self, lock: "TrackedLock") -> None:
+        held = self._held()
+        if any(entry is lock for entry in held):
+            held.append(lock)   # reentrant re-acquire: no new edges
+            return
+        site = lock._site
+        hit: Violation | None = None
+        with self._mu:
+            for h in held:
+                hs = h._site
+                if hs == site:
+                    continue    # sibling from the same allocation site
+                fwd, rev = (hs, site), (site, hs)
+                if fwd in self.edges:
+                    continue    # steady state: no stack capture, no cost
+                if rev in self.edges:
+                    hit = Violation(
+                        first=hs, second=site,
+                        thread=threading.current_thread().name,
+                        stack=_short_stack(),
+                        prior_stack=self.edges[rev])
+                    self.violations_list.append(hit)
+                self.edges[fwd] = _short_stack()
+        held.append(lock)
+        if hit is not None and self.raise_on_violation:
+            raise LockOrderViolation(hit.describe())
+
+    def on_released(self, lock: "TrackedLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def on_released_all(self, lock: "TrackedLock") -> None:
+        held = self._held()
+        held[:] = [entry for entry in held if entry is not lock]
+
+
+TRACKER = _Tracker()
+
+
+class TrackedLock:
+    """Delegating wrapper around a real Lock/RLock.
+
+    Implements the full lock protocol plus the private
+    ``_release_save`` / ``_acquire_restore`` / ``_is_owned`` trio so a
+    ``threading.Condition`` built on a tracked RLock still releases
+    every recursion level across ``wait()``.
+    """
+
+    __slots__ = ("_inner", "_site", "_kind")
+
+    def __init__(self, inner, site: str, kind: str):
+        self._inner = inner
+        self._site = site
+        self._kind = kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            TRACKER.on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        TRACKER.on_released(self)
+        self._inner.release()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # --- Condition integration (RLock protocol) ---
+
+    def _release_save(self):
+        TRACKER.on_released_all(self)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        TRACKER.on_acquired(self)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # plain Lock fallback mirroring threading.Condition's own trick
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self._kind} from {self._site}>"
+
+
+def _make_factory(orig, kind: str):
+    def factory(*args, **kwargs):
+        inner = orig(*args, **kwargs)
+        frame = sys._getframe(1)
+        mod = frame.f_globals.get("__name__", "")
+        if not mod.startswith(_SCOPE_PREFIXES):
+            return inner
+        site = f"{mod}:{frame.f_lineno}"
+        return TrackedLock(inner, site, kind)
+    factory._seaweed_lockcheck = True  # idempotence marker
+    return factory
+
+
+_installed = False
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def install(raise_on_violation: bool = False) -> None:
+    """Patch the threading.Lock/RLock factories (idempotent)."""
+    global _installed
+    if _installed:
+        TRACKER.raise_on_violation = raise_on_violation
+        return
+    TRACKER.raise_on_violation = raise_on_violation
+    threading.Lock = _make_factory(_ORIG_LOCK, "Lock")
+    threading.RLock = _make_factory(_ORIG_RLOCK, "RLock")
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the original factories. Locks already created stay
+    tracked (they keep working; they just keep reporting)."""
+    global _installed
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _installed = False
+
+
+def install_from_env() -> bool:
+    """Honor SEAWEED_LOCKCHECK: "1"/"record" records, "raise" also
+    raises LockOrderViolation at the offending acquire."""
+    mode = os.environ.get("SEAWEED_LOCKCHECK", "").strip().lower()
+    if mode in ("1", "true", "record", "on"):
+        install(raise_on_violation=False)
+    elif mode == "raise":
+        install(raise_on_violation=True)
+    return _installed
+
+
+def violations() -> list[Violation]:
+    return list(TRACKER.violations_list)
+
+
+def reset() -> None:
+    """Clear the recorded graph and violations (tests)."""
+    with TRACKER._mu:
+        TRACKER.edges.clear()
+        TRACKER.violations_list.clear()

@@ -33,6 +33,40 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # ---------------------------------------------------------------------------
+# Runtime lock-order checking (the dynamic half of seaweedlint).
+#
+# Record mode for the whole tier-1 suite: every threading.Lock/RLock
+# created by seaweedfs_tpu code is wrapped, acquisition order is
+# recorded per creation site, and an observed A→B / B→A inversion
+# fails the session at the end (see pytest_sessionfinish below).
+# Opt out with SEAWEED_LOCKCHECK=0; use =raise to fault at the
+# offending acquire instead of at session end.
+# ---------------------------------------------------------------------------
+
+os.environ.setdefault("SEAWEED_LOCKCHECK", "1")
+
+from seaweedfs_tpu.util import lockcheck  # noqa: E402
+
+lockcheck.install_from_env()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    viols = lockcheck.violations()
+    if not viols:
+        return
+    terminalreporter.section("seaweed lockcheck: lock-order violations")
+    for v in viols:
+        terminalreporter.write_line(v.describe())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # Tests that deliberately provoke inversions (tests/test_lockcheck.py)
+    # clean up after themselves via lockcheck.reset(); anything left here
+    # is a real ordering bug observed somewhere in the suite.
+    if lockcheck.violations() and session.exitstatus == 0:
+        session.exitstatus = 1
+
+# ---------------------------------------------------------------------------
 # Prometheus exposition-format mini parser (shared by metrics tests).
 # ---------------------------------------------------------------------------
 
