@@ -59,6 +59,7 @@ CFG3_TIMEOUT = 480
 CFG5_TIMEOUT = 420
 CACHE_TIMEOUT = 180      # chunk-cache zipfian stage (pure CPU, no jax)
 TRACE_TIMEOUT = 300      # tracing-overhead stage (CPU mini cluster)
+TELEMETRY_TIMEOUT = 300  # telemetry-overhead stage (CPU mini cluster)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -219,6 +220,12 @@ def parent() -> None:
     rc, out = _run(["--child-trace-overhead"], _scrubbed_env(),
                    TRACE_TIMEOUT)
     stage_platforms["trace"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Telemetry-collection tax on the same path — same design.
+    rc, out = _run(["--child-telemetry-overhead"], _scrubbed_env(),
+                   TELEMETRY_TIMEOUT)
+    stage_platforms["telemetry"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1541,21 +1548,25 @@ def child_cache() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-#: Server half of the trace-overhead stage: master + volume + filer in
-#: ONE subprocess, so client-visible latency crosses a real process
-#: boundary (co-locating client and servers would bill every
+#: Server half of the trace/telemetry overhead stages: master + volume
+#: + filer in ONE subprocess, so client-visible latency crosses a real
+#: process boundary (co-locating client and servers would bill every
 #: server-side GIL hold to the client and overstate the tax).
-#: Tracing toggles at runtime via stdin ("on"/"off" lines) so both
-#: modes are measured against the SAME process — separate clusters
-#: differ by ±20us in baseline latency, swamping the signal.
-_TRACE_SERVER_HELPER = r"""
+#: The observability plane named by argv[2] ("tracing" or "telemetry")
+#: toggles at runtime via stdin ("on"/"off" lines) so both modes are
+#: measured against the SAME process — separate clusters differ by
+#: ±20us in baseline latency, swamping the signal.
+_OVERHEAD_SERVER_HELPER = r"""
 import sys, socket, time
+from seaweedfs_tpu.cluster import telemetry
 from seaweedfs_tpu.cluster.filer_server import FilerServer
 from seaweedfs_tpu.cluster.master import MasterServer
 from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.util import tracing
+
+plane = tracing if sys.argv[2] == "tracing" else telemetry
 
 def fpp():
     for _ in range(50):
@@ -1583,37 +1594,33 @@ while time.time() < deadline and not master.topology.nodes:
     time.sleep(0.05)
 print("READY", filer.url, flush=True)
 for line in sys.stdin:
-    tracing.configure(enabled=(line.strip() == "on"))
+    plane.configure(enabled=(line.strip() == "on"))
     print("ACK", flush=True)
 """
 
 
-def child_trace_overhead() -> None:
-    """Tracing tax on the cached-read path (docs/observability.md).
-
-    Boots the read stack (master + volume + filer) in a subprocess
-    and times warm filer GETs of a chunk-sized (1 MiB, the cache
-    stage's chunk scale) object — the cached read this PR's tracing
-    instruments end to end — with tracing toggled off/on between
-    interleaved blocks via the helper's stdin. One process serves
-    both modes (separate clusters differ by more than the span cost
-    in baseline latency) and per-request medians discard scheduler
-    stalls. Acceptance (ISSUE 2): overhead < 5%."""
+def _measure_plane_overhead(plane: str) -> tuple:
+    """Median warm 1 MiB filer-read latency with the named
+    observability plane off vs on. Shared harness for the trace- and
+    telemetry-overhead stages: one subprocess cluster serves both
+    modes (separate clusters differ by more than the instrumentation
+    cost in baseline latency) and per-request medians discard
+    scheduler stalls. Returns ``(t_off, t_on)`` seconds."""
     import shutil
     import statistics
     import tempfile
     import urllib.request
 
-    tmp = tempfile.mkdtemp(prefix="bench_trace_")
+    tmp = tempfile.mkdtemp(prefix=f"bench_{plane}_")
     proc = subprocess.Popen(
-        [sys.executable, "-c", _TRACE_SERVER_HELPER, tmp],
+        [sys.executable, "-c", _OVERHEAD_SERVER_HELPER, tmp, plane],
         env=dict(os.environ), cwd=REPO, stdin=subprocess.PIPE,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     try:
         line = proc.stdout.readline().split()
         if not line or line[0] != "READY":
-            raise RuntimeError("trace helper failed to boot")
-        url = f"http://{line[1]}/bench/trace.bin"
+            raise RuntimeError(f"{plane} helper failed to boot")
+        url = f"http://{line[1]}/bench/{plane}.bin"
         req = urllib.request.Request(url, data=os.urandom(MIB),
                                      method="PUT")
         with urllib.request.urlopen(req) as r:
@@ -1623,7 +1630,7 @@ def child_trace_overhead() -> None:
             proc.stdin.write(mode + "\n")
             proc.stdin.flush()
             if proc.stdout.readline().strip() != "ACK":
-                raise RuntimeError("trace helper lost")
+                raise RuntimeError(f"{plane} helper lost")
 
         def block(count: int) -> list:
             lat = []
@@ -1642,13 +1649,24 @@ def child_trace_overhead() -> None:
                 set_mode(mode)
                 block(20)
                 lat[mode] += block(150)
-        t_off = statistics.median(lat["off"])
-        t_on = statistics.median(lat["on"])
+        return (statistics.median(lat["off"]),
+                statistics.median(lat["on"]))
     finally:
         proc.kill()
         proc.wait()
         shutil.rmtree(tmp, ignore_errors=True)
 
+
+def child_trace_overhead() -> None:
+    """Tracing tax on the cached-read path (docs/observability.md).
+
+    Boots the read stack (master + volume + filer) in a subprocess
+    and times warm filer GETs of a chunk-sized (1 MiB, the cache
+    stage's chunk scale) object — the cached read this PR's tracing
+    instruments end to end — with tracing toggled off/on between
+    interleaved blocks via the helper's stdin.
+    Acceptance (ISSUE 2): overhead < 5%."""
+    t_off, t_on = _measure_plane_overhead("tracing")
     overhead = (t_on - t_off) / t_off
     res = {
         "trace_overhead_pct": round(overhead * 100, 2),
@@ -1660,6 +1678,31 @@ def child_trace_overhead() -> None:
         f"off / {res['trace_read_us_on']}us on -> "
         f"{res['trace_overhead_pct']}% overhead "
         f"({'OK' if res['trace_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
+def child_telemetry_overhead() -> None:
+    """Telemetry-collection tax on the same cached-read path.
+
+    Identical harness to the trace stage, but the stdin toggle flips
+    ``telemetry.configure(enabled=...)`` on the server process, so the
+    difference is exactly the per-request collector cost (counter
+    bumps + digest appends) plus the per-pulse snapshot drain.
+    Acceptance (ISSUE 4): overhead < 5%."""
+    t_off, t_on = _measure_plane_overhead("telemetry")
+    overhead = (t_on - t_off) / t_off
+    res = {
+        "telemetry_overhead_pct": round(overhead * 100, 2),
+        "telemetry_read_us_off": round(t_off * 1e6, 1),
+        "telemetry_read_us_on": round(t_on * 1e6, 1),
+        "telemetry_overhead_ok": bool(overhead < 0.05),
+    }
+    log(f"telemetry stage: cached read "
+        f"{res['telemetry_read_us_off']}us off / "
+        f"{res['telemetry_read_us_on']}us on -> "
+        f"{res['telemetry_overhead_pct']}% overhead "
+        f"({'OK' if res['telemetry_overhead_ok'] else 'OVER BUDGET'})")
     _persist(res)
     print(json.dumps(res), flush=True)
 
@@ -1683,5 +1726,8 @@ if __name__ == "__main__":
     elif ("--child-trace-overhead" in sys.argv
           or "--trace-overhead" in sys.argv):
         child_trace_overhead()
+    elif ("--child-telemetry-overhead" in sys.argv
+          or "--telemetry-overhead" in sys.argv):
+        child_telemetry_overhead()
     else:
         parent()

@@ -1,10 +1,13 @@
 #!/bin/bash
 # Observability smoke (docs/observability.md): boots a 1-volume cluster
 # with a filer, performs one write and one traced read, then fails if
-#   - any server's /metrics is missing, mislabeled, or unparseable as
-#     Prometheus exposition text, or
+#   - any server's /metrics is missing, mislabeled, or unparseable by
+#     the suite's mini Prometheus parser (tests/conftest.py), or
 #   - the traced read left fewer than 4 spans across the servers'
-#     /debug/traces rings (the ISSUE's end-to-end acceptance bar).
+#     /debug/traces rings (the ISSUE's end-to-end acceptance bar), or
+#   - the read's per-volume hot stats are not visible at the master's
+#     /cluster/telemetry within two heartbeats, or
+#   - any server's /debug/vars is missing or not well-formed JSON.
 #
 #   bash scripts/metrics_smoke.sh [portBase] [workdir]
 set -euo pipefail
@@ -23,7 +26,7 @@ say() { printf '\n== %s ==\n' "$*"; }
 
 mkdir -p "$WORK/data"
 $W cluster -dir "$WORK/data" -volumes 1 -filer -portBase "$PORT" \
-  > "$WORK/cluster.log" 2>&1 &
+  -pulseSeconds 1 > "$WORK/cluster.log" 2>&1 &
 CPID=$!
 trap 'kill $CPID 2>/dev/null; sleep 1' EXIT
 for _ in $(seq 1 120); do
@@ -41,28 +44,23 @@ curl -sf -H "X-Seaweed-Trace: $TID-00000001" \
 cmp "$WORK/payload.bin" "$WORK/readback.bin" && echo "read-back: OK"
 sleep 1   # let every hop's ingress root close and land in its ring
 
-say "/metrics must parse as Prometheus exposition on every server"
+say "/metrics must parse with the suite's mini Prometheus parser"
 for URL in "$M" "$V" "$F"; do
   curl -sf -D "$WORK/hdrs" "http://$URL/metrics" -o "$WORK/metrics.txt"
   grep -qi '^content-type: text/plain; version=0.0.4' "$WORK/hdrs" ||
     { echo "FAIL: $URL/metrics wrong Content-Type"; exit 1; }
   python - "$URL" "$WORK/metrics.txt" <<'EOF'
-import re, sys
+import sys
+sys.path.insert(0, "tests")
+from conftest import parse_exposition
 url, path = sys.argv[1], sys.argv[2]
-pat = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
-    r' (\+Inf|-?[0-9].*|nan|inf)$')
-n = 0
-for line in open(path, encoding="utf-8"):
-    line = line.rstrip("\n")
-    if not line.strip() or line.startswith("#"):
-        continue
-    if pat.match(line) is None:
-        sys.exit(f"FAIL: {url}/metrics malformed line: {line!r}")
-    n += 1
-print(f"{url}/metrics: {n} samples, all well-formed")
+try:
+    families = parse_exposition(open(path, encoding="utf-8").read())
+except ValueError as e:
+    sys.exit(f"FAIL: {url}/metrics unparseable: {e}")
+n = sum(len(v) for v in families.values())
+print(f"{url}/metrics: {n} samples in {len(families)} families, "
+      f"all well-formed")
 EOF
 done
 
@@ -88,5 +86,70 @@ print(f"trace {tid}: {spans} spans across servers: {sorted(names)}")
 if spans < 4:
     sys.exit(f"FAIL: traced read produced {spans} spans (< 4)")
 EOF
+
+say "the read's hot stats must reach /cluster/telemetry in <=2 pulses"
+# the write+read above happened >=1 pulse ago; poll for at most two
+# more pulse periods (pulse is 1s here) before calling it a failure
+OK=0
+for _ in $(seq 1 8); do
+  curl -sf "http://$M/cluster/telemetry" -o "$WORK/telemetry.json" &&
+    python - "$WORK/telemetry.json" <<'EOF' && OK=1 && break
+import json, sys
+doc = json.load(open(sys.argv[1], encoding="utf-8"))
+nodes = doc.get("nodes", {})
+vols = doc.get("volumes", {})
+reads = sum(row.get("read_ops", 0)
+            for per_node in vols.values() for row in per_node.values())
+if not nodes or reads < 1:
+    sys.exit(1)
+for url, n in nodes.items():
+    h = n.get("health", {})
+    if "score" not in h or "verdict" not in h:
+        sys.exit(f"FAIL: node {url} missing health score")
+EOF
+  sleep 0.5
+done
+[ "$OK" = 1 ] || { echo "FAIL: read not visible at /cluster/telemetry"
+                   cat "$WORK/telemetry.json" 2>/dev/null; exit 1; }
+python - "$WORK/telemetry.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1], encoding="utf-8"))
+for url, n in doc["nodes"].items():
+    h = n["health"]
+    print(f"node {url}: {h['verdict']} (score {h['score']}), "
+          f"{n['volume_count']} volumes")
+EOF
+
+say "telemetry gauges must appear in the master's /metrics"
+curl -sf "http://$M/metrics" -o "$WORK/metrics.txt"
+python - "$WORK/metrics.txt" <<'EOF'
+import sys
+sys.path.insert(0, "tests")
+from conftest import parse_exposition
+fams = parse_exposition(open(sys.argv[1], encoding="utf-8").read())
+want = ["master_telemetry_volume_read_ops_per_second",
+        "master_telemetry_volume_cache_hit_ratio",
+        "master_telemetry_node_read_ops_per_second"]
+missing = [w for w in want if not any(f.startswith(w) for f in fams)]
+if missing:
+    sys.exit(f"FAIL: master /metrics missing {missing}")
+print("master telemetry gauges present:", ", ".join(want))
+EOF
+
+say "/debug/vars must serve well-formed JSON on every server"
+for URL in "$M" "$V" "$F"; do
+  curl -sf "http://$URL/debug/vars" -o "$WORK/vars.json" ||
+    { echo "FAIL: $URL/debug/vars unreachable"; exit 1; }
+  python - "$URL" "$WORK/vars.json" <<'EOF'
+import json, sys
+url, path = sys.argv[1], sys.argv[2]
+doc = json.load(open(path, encoding="utf-8"))
+for key in ("component", "pid", "uptime_seconds", "slow_requests"):
+    if key not in doc:
+        sys.exit(f"FAIL: {url}/debug/vars missing {key!r}")
+print(f"{url}/debug/vars: component={doc['component']} "
+      f"pid={doc['pid']} uptime={doc['uptime_seconds']:.1f}s")
+EOF
+done
 
 say "SMOKE PASSED — workdir: $WORK"

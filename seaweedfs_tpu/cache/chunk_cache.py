@@ -23,7 +23,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from ..util import tracing
-from ..util.stats import Metrics
+from ..util.stats import Counter, Metrics
 from . import invalidation
 from .disk_tier import DiskTier
 
@@ -44,6 +44,21 @@ def chunk_key(master_url: str, fid: str) -> str:
     a cluster: volume ids and needle keys are small integers that
     collide across clusters (and across tests) with different bytes."""
     return f"chunk:{master_url}:{fid}"
+
+
+def key_volume(key: str) -> Optional[int]:
+    """Volume id out of any cache-key shape ('chunk:<master>:<fid>',
+    'ec:<vid>:<key>:<cookie>', or a bare fid); None when unparseable.
+    Used to attribute misses — a miss has no stored entry to carry the
+    volume tag."""
+    if key.startswith("ec:"):
+        try:
+            return int(key.split(":", 2)[1])
+        except (ValueError, IndexError):
+            return None
+    if key.startswith("chunk:"):
+        return fid_volume(key.rsplit(":", 1)[-1])
+    return fid_volume(key)
 
 
 class _Entry:
@@ -176,6 +191,15 @@ class ChunkCache:
                                                 tier="disk")
         self._g_disk_entries = self.metrics.gauge("cache_entries",
                                                   tier="disk")
+        # Per-volume hit/miss/reject counters (cache_volume_* families)
+        # feed the telemetry plane's per-volume heartbeat stats. The
+        # label space is capped: the first _vol_label_cap distinct
+        # volumes get their own series, the rest share volume="other",
+        # so a pathological workload can't mint unbounded label values.
+        self._vol_label_cap = 128
+        self._vol_counters: dict[tuple[str, int], Counter] = {}
+        self._vol_labelled: set[int] = set()
+        self._m_vol_other: dict[str, Counter] = {}
         self.clock = clock
         self._volumes: dict[int, set[str]] = {}
         self.hits = 0
@@ -201,6 +225,41 @@ class ChunkCache:
         if self._disk is not None:
             self._g_disk_bytes.set(self._disk.bytes)
             self._g_disk_entries.set(self._disk.entries)
+
+    def _vol_count(self, kind: str, volume: Optional[int]) -> None:
+        """Bump the per-volume counter for one hit/miss/reject. Caller
+        holds ``self._lock`` (membership checks and the labelled set
+        are lock-protected state)."""
+        if volume is None:
+            return
+        c = self._vol_counters.get((kind, volume))
+        if c is None:
+            if volume in self._vol_labelled or \
+                    len(self._vol_labelled) < self._vol_label_cap:
+                self._vol_labelled.add(volume)
+                c = self.metrics.counter(
+                    f"cache_volume_{kind}",
+                    # seaweedlint: disable=SW401 — _vol_label_cap caps ids, then "other"
+                    volume=str(volume))
+                self._vol_counters[(kind, volume)] = c
+            else:
+                c = self._m_vol_other.get(kind)
+                if c is None:
+                    c = self.metrics.counter(f"cache_volume_{kind}",
+                                             volume="other")
+                    self._m_vol_other[kind] = c
+                # NOT cached under (kind, volume): the cache dict must
+                # stay bounded by the label cap
+        c.inc()
+
+    def per_volume_counts(self) -> dict[int, dict[str, int]]:
+        """{volume_id: {"hits": n, "misses": n, "rejects": n}} for the
+        labelled volumes (telemetry heartbeat source)."""
+        with self._lock:
+            out: dict[int, dict[str, int]] = {}
+            for (kind, vid), c in self._vol_counters.items():
+                out.setdefault(vid, {})[kind] = int(c.value)
+            return out
 
     def _track(self, key: str, volume: Optional[int]) -> None:
         if volume is not None:
@@ -242,6 +301,7 @@ class ChunkCache:
                 else:
                     self.hits += 1
                     self._m_hit_mem.inc()
+                    self._vol_count("hits", e.volume)
                     return e.data
             elif self._disk is not None:
                 rec = self._disk.get(key)
@@ -249,6 +309,7 @@ class ChunkCache:
                     data, volume, expires = rec
                     self.hits += 1
                     self._m_hit_disk.inc()
+                    self._vol_count("hits", volume)
                     # promote back into memory probation
                     if len(data) <= self.admission_max:
                         self._insert_mem(key, _Entry(data, expires,
@@ -256,6 +317,7 @@ class ChunkCache:
                     return data
             self.misses += 1
             self._m_miss.inc()
+            self._vol_count("misses", key_volume(key))
             return None
 
     def put(self, key: str, data: bytes, volume: Optional[int] = None,
@@ -279,6 +341,7 @@ class ChunkCache:
             if len(data) > self.admission_max:
                 self.admission_rejects += 1
                 self._m_reject.inc()
+                self._vol_count("rejects", volume)
                 # a too-big-for-memory item may still fit the disk tier
                 if self._disk is not None and self._disk.admit(len(data)):
                     self._disk.put(key, data, volume, expires)

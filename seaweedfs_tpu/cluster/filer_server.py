@@ -27,6 +27,7 @@ from ..filer.stores import MemoryStore, SqliteStore
 from ..pb import filer_pb2
 from ..util import glog
 from ..util import tracing
+from ..util import varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from .master import _grpc_port
 from .wdclient import MasterClient
@@ -366,6 +367,10 @@ def _make_http_handler(fs: FilerServer):
                 q = {k: v[0] for k, v in parse_qs(u.query).items()}
                 self._send(200, json.dumps(tracing.debug_payload(
                     int(q["limit"]) if "limit" in q else None)).encode())
+                return
+            if u.path == "/debug/vars":
+                self._send(200, json.dumps(
+                    varz.payload("filer", fs.metrics)).encode())
                 return
             path, q = self._path()
             fs.metrics.counter("request_total", method="GET").inc()

@@ -34,6 +34,7 @@ from ..util import glog
 from ..util import security
 from ..util import tls as tls_mod
 from ..util import tracing
+from ..util import varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import ha as ha_mod
 from .ha import NotLeaderError
@@ -484,6 +485,9 @@ class _MasterServicer:
                 data_center=hb.data_center, rack=hb.rack,
                 max_volume_count=hb.max_volume_count or 8,
                 volumes=volumes, ec_shards=ec)
+            if hb.HasField("telemetry"):
+                ms.topology.telemetry.ingest(url, hb.telemetry,
+                                             metrics=ms.metrics)
             if hb.max_file_key:
                 ms.sequencer.set_max(hb.max_file_key)
             yield master_pb2.HeartbeatResponse(
@@ -680,10 +684,26 @@ def _make_http_handler(ms: MasterServer):
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif u.path == "/cluster/telemetry":
+                    # Volume servers heartbeat only the leader, so a
+                    # follower's registry is cold — answer from the
+                    # leader's.
+                    if self._proxy_to_leader():
+                        return
+                    last_seen = {n.url: n.last_seen
+                                 for n in ms.topology.snapshot_nodes()}
+                    self._json(ms.topology.telemetry.to_map(
+                        nodes_last_seen=last_seen,
+                        pulse_seconds=ms.topology.pulse_seconds))
                 elif u.path == "/debug/traces":
                     self._json(tracing.debug_payload(
                         int(q.get("limit", -1))
                         if q.get("limit") else None))
+                elif u.path == "/debug/vars":
+                    self._json(varz.payload(
+                        "master", ms.metrics,
+                        extra={"is_leader": ms.is_leader,
+                               "nodes": len(ms.topology.nodes)}))
                 else:
                     self._json({"error": "not found"}, 404)
             except NotLeaderError as e:

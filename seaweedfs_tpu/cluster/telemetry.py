@@ -1,0 +1,521 @@
+"""Cluster telemetry plane: per-volume hot stats over heartbeats.
+
+Monarch-style push aggregation (PAPERS.md): each volume server keeps a
+:class:`TelemetryCollector` of per-volume hot stats — read/write ops,
+bytes, chunk-cache hits/misses, EC decodes, errors, and latency
+:class:`~seaweedfs_tpu.util.stats.Digest`\\ s — and ships a compact
+:class:`master_pb.TelemetrySnapshot` on every heartbeat. The master
+folds snapshots into a :class:`ClusterTelemetry` registry: monotonic
+counters become exponentially-decayed rates, latency digests are kept
+as a sliding window of mergeable sketches (so ``p99`` at the master is
+computed over real sample positions, not re-bucketed histograms), and
+each node gets a health score from heartbeat staleness, error rate,
+and tail latency vs the cluster median.
+
+Counters in a snapshot are cumulative since process start (a restart
+shows up as a counter regression and is treated as a fresh baseline);
+digests are drained per heartbeat window so the master's sliding
+window only ever holds recent samples.
+
+The collector hot path is gated on a module flag
+(:func:`configure` / ``[telemetry] enabled`` in the server config), so
+``bench.py --telemetry-overhead`` can toggle it at runtime the same
+way the tracing bench does.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Iterable, Optional
+
+from ..pb import master_pb2
+from ..util.stats import Digest, Metrics
+
+_ENABLED = True
+
+#: Default half-life for master-side rate decay (seconds).
+DECAY_HALFLIFE = 60.0
+#: Latency digests older than this fall out of the master's window.
+DIGEST_WINDOW = 300.0
+#: Centroid budget for shipped digests (~1 KiB per digest on the wire).
+DIGEST_CENTROIDS = 64
+
+
+def configure(enabled: Optional[bool] = None) -> None:
+    global _ENABLED
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+
+
+def configure_from(conf: dict) -> None:
+    """Apply a ``[telemetry]`` config-file section, if present."""
+    t = conf.get("telemetry") if isinstance(conf, dict) else None
+    if isinstance(t, dict):
+        configure(enabled=t.get("enabled"))
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# --------------------------------------------------------------------------
+# volume-server side: the collector
+# --------------------------------------------------------------------------
+
+
+class _VolStats:
+    __slots__ = ("read_ops", "write_ops", "read_bytes", "write_bytes",
+                 "ec_decodes", "errors", "read_latency", "write_latency")
+
+    def __init__(self):
+        self.read_ops = 0
+        self.write_ops = 0
+        self.read_bytes = 0
+        self.write_bytes = 0
+        self.ec_decodes = 0
+        self.errors = 0
+        self.read_latency = Digest(DIGEST_CENTROIDS)
+        self.write_latency = Digest(DIGEST_CENTROIDS)
+
+
+class TelemetryCollector:
+    """Per-volume hot stats on one volume server.
+
+    ``record_*`` are hot-path safe: one module-flag predicate when
+    disabled; a dict hit plus integer bumps and a buffered digest
+    append when enabled.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vols: dict[int, _VolStats] = {}
+        self._window_start = time.monotonic()
+
+    def _vol(self, volume_id: int) -> _VolStats:
+        v = self._vols.get(volume_id)
+        if v is None:
+            v = self._vols[volume_id] = _VolStats()
+        return v
+
+    def record_read(self, volume_id: int, n_bytes: int,
+                    seconds: float, error: bool = False) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            v = self._vol(volume_id)
+            v.read_ops += 1
+            v.read_bytes += n_bytes
+            if error:
+                v.errors += 1
+        v.read_latency.add(seconds)
+
+    def record_write(self, volume_id: int, n_bytes: int,
+                     seconds: float, error: bool = False) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            v = self._vol(volume_id)
+            v.write_ops += 1
+            v.write_bytes += n_bytes
+            if error:
+                v.errors += 1
+        v.write_latency.add(seconds)
+
+    def record_ec_decode(self, volume_id: int, n: int = 1) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._vol(volume_id).ec_decodes += n
+
+    def snapshot(self, cache_counts: Optional[dict] = None,
+                 collections: Optional[dict] = None
+                 ) -> master_pb2.TelemetrySnapshot:
+        """Drain one heartbeat window into a wire snapshot.
+
+        Counters ship cumulative; digests are swapped out so each
+        snapshot carries only the latencies observed since the last
+        one. ``cache_counts`` is ``ChunkCache.per_volume_counts()``
+        (cumulative hits/misses keyed by volume id); ``collections``
+        maps volume id -> collection name for labeling.
+        """
+        now = time.monotonic()
+        snap = master_pb2.TelemetrySnapshot(
+            window_ns=max(0, int((now - self._window_start) * 1e9)))
+        cache_counts = cache_counts or {}
+        collections = collections or {}
+        with self._lock:
+            self._window_start = now
+            vids = sorted(set(self._vols) | set(cache_counts))
+            drained: list[tuple[int, _VolStats, Digest, Digest]] = []
+            for vid in vids:
+                v = self._vols.get(vid)
+                if v is None:
+                    v = self._vols[vid] = _VolStats()
+                rd, v.read_latency = v.read_latency, \
+                    Digest(DIGEST_CENTROIDS)
+                wd, v.write_latency = v.write_latency, \
+                    Digest(DIGEST_CENTROIDS)
+                drained.append((vid, v, rd, wd))
+        for vid, v, rd, wd in drained:
+            cc = cache_counts.get(vid, {})
+            m = snap.volumes.add(
+                volume_id=vid,
+                collection=str(collections.get(vid, "")),
+                read_ops=v.read_ops, write_ops=v.write_ops,
+                read_bytes=v.read_bytes, write_bytes=v.write_bytes,
+                cache_hits=int(cc.get("hits", 0)),
+                cache_misses=int(cc.get("misses", 0)),
+                ec_decodes=v.ec_decodes, errors=v.errors)
+            if rd.count:
+                m.read_latency.CopyFrom(rd.to_proto())
+            if wd.count:
+                m.write_latency.CopyFrom(wd.to_proto())
+        return snap
+
+    def to_map(self) -> dict:
+        """JSON-able local view (volume server ``/debug/vars``)."""
+        with self._lock:
+            items = list(self._vols.items())
+        out = {}
+        for vid, v in items:
+            out[str(vid)] = {
+                "read_ops": v.read_ops, "write_ops": v.write_ops,
+                "read_bytes": v.read_bytes,
+                "write_bytes": v.write_bytes,
+                "ec_decodes": v.ec_decodes, "errors": v.errors,
+                "read_latency": _digest_summary(v.read_latency),
+                "write_latency": _digest_summary(v.write_latency),
+            }
+        return out
+
+
+def _digest_summary(d: Digest) -> dict:
+    if not d.count:
+        return {"count": 0}
+    out = {"count": d.count, "mean": d.sum / d.count}
+    out.update(d.percentiles(0.5, 0.95, 0.99))
+    return out
+
+
+# --------------------------------------------------------------------------
+# master side: rolling aggregation with decay + health scoring
+# --------------------------------------------------------------------------
+
+_RATE_FIELDS = ("read_ops", "write_ops", "read_bytes", "write_bytes",
+                "cache_hits", "cache_misses", "ec_decodes", "errors")
+
+
+class _VolAgg:
+    __slots__ = ("cum", "rates", "windows", "collection")
+
+    def __init__(self):
+        self.cum: dict[str, int] = {f: 0 for f in _RATE_FIELDS}
+        self.rates: dict[str, float] = {f: 0.0 for f in _RATE_FIELDS}
+        #: (wall ts, read Digest | None, write Digest | None)
+        self.windows: deque = deque()
+        self.collection = ""
+
+
+class _NodeAgg:
+    __slots__ = ("volumes", "last_ingest", "snapshots")
+
+    def __init__(self):
+        self.volumes: dict[int, _VolAgg] = {}
+        self.last_ingest = 0.0
+        self.snapshots = 0
+
+
+class ClusterTelemetry:
+    """Rolling per-node / per-volume registry at the master.
+
+    Rates are EWMA-decayed with half-life ``halflife`` so a volume
+    that went cold shows a falling rate instead of its lifetime mean;
+    latency digests are kept for ``window`` seconds and merged on
+    demand for quantile queries.
+    """
+
+    def __init__(self, halflife: float = DECAY_HALFLIFE,
+                 window: float = DIGEST_WINDOW,
+                 clock=time.time):
+        self._lock = threading.Lock()
+        self._nodes: dict[str, _NodeAgg] = {}
+        self.halflife = max(1.0, float(halflife))
+        self.window = max(1.0, float(window))
+        self.clock = clock
+
+    # ---------------- ingestion ----------------
+
+    def ingest(self, node_url: str,
+               snap: master_pb2.TelemetrySnapshot,
+               metrics: Optional[Metrics] = None) -> None:
+        now = self.clock()
+        with self._lock:
+            node = self._nodes.get(node_url)
+            if node is None:
+                node = self._nodes[node_url] = _NodeAgg()
+            dt = now - node.last_ingest if node.last_ingest else \
+                max(snap.window_ns / 1e9, 1e-3)
+            dt = max(dt, 1e-3)
+            alpha = 1.0 - 0.5 ** (dt / self.halflife)
+            node.last_ingest = now
+            node.snapshots += 1
+            seen = set()
+            for v in snap.volumes:
+                seen.add(v.volume_id)
+                agg = node.volumes.get(v.volume_id)
+                if agg is None:
+                    agg = node.volumes[v.volume_id] = _VolAgg()
+                if v.collection:
+                    agg.collection = v.collection
+                for f in _RATE_FIELDS:
+                    new = getattr(v, f)
+                    prev = agg.cum[f]
+                    # counter regression == server restart: the new
+                    # cumulative value IS the delta since the reset
+                    delta = new - prev if new >= prev else new
+                    agg.cum[f] = new
+                    agg.rates[f] += alpha * (delta / dt - agg.rates[f])
+                rd = Digest.from_proto(v.read_latency) \
+                    if v.read_latency.count else None
+                wd = Digest.from_proto(v.write_latency) \
+                    if v.write_latency.count else None
+                if rd is not None or wd is not None:
+                    agg.windows.append((now, rd, wd))
+                while agg.windows and \
+                        now - agg.windows[0][0] > self.window:
+                    agg.windows.popleft()
+            # volumes absent from the snapshot decay toward zero
+            for vid, agg in node.volumes.items():
+                if vid in seen:
+                    continue
+                for f in _RATE_FIELDS:
+                    agg.rates[f] -= alpha * agg.rates[f]
+                while agg.windows and \
+                        now - agg.windows[0][0] > self.window:
+                    agg.windows.popleft()
+        if metrics is not None:
+            self._update_gauges(metrics, node_url)
+
+    def forget(self, node_url: str) -> None:
+        """Drop a node (reaped from the topology)."""
+        with self._lock:
+            self._nodes.pop(node_url, None)
+
+    def _update_gauges(self, metrics: Metrics, node_url: str) -> None:
+        """Master-side Prometheus gauges for the node just ingested.
+
+        Cardinality is bounded by live (node, volume) pairs — the same
+        bound the topology itself carries.
+        """
+        view = self.node_volumes(node_url)
+        tot_read = tot_write = 0.0
+        for vid, row in view.items():
+            tot_read += row["read_ops_per_second"]
+            tot_write += row["write_ops_per_second"]
+            metrics.gauge(
+                "telemetry_volume_read_ops_per_second",
+                # seaweedlint: disable=SW401 — bounded by live volumes
+                node=node_url, volume=str(vid)).set(
+                    row["read_ops_per_second"])
+            metrics.gauge(
+                "telemetry_volume_cache_hit_ratio",
+                # seaweedlint: disable=SW401 — bounded by live volumes
+                node=node_url, volume=str(vid)).set(
+                    row["cache_hit_ratio"])
+        metrics.gauge("telemetry_node_read_ops_per_second",
+                      node=node_url).set(tot_read)
+        metrics.gauge("telemetry_node_write_ops_per_second",
+                      node=node_url).set(tot_write)
+        p99 = self.node_quantile(node_url, 0.99)
+        if p99 is not None:
+            metrics.gauge("telemetry_node_read_p99_seconds",
+                          node=node_url).set(p99)
+
+    # ---------------- views ----------------
+
+    def _decay_factor(self, node: _NodeAgg, now: float) -> float:
+        if not node.last_ingest:
+            return 1.0
+        return 0.5 ** (max(0.0, now - node.last_ingest) / self.halflife)
+
+    def node_volumes(self, node_url: str) -> dict:
+        """Per-volume rows for one node (decayed to 'now')."""
+        now = self.clock()
+        with self._lock:
+            node = self._nodes.get(node_url)
+            if node is None:
+                return {}
+            decay = self._decay_factor(node, now)
+            out = {}
+            for vid, agg in node.volumes.items():
+                hits = agg.cum["cache_hits"]
+                misses = agg.cum["cache_misses"]
+                looked = hits + misses
+                row = {
+                    "collection": agg.collection,
+                    "read_ops": agg.cum["read_ops"],
+                    "write_ops": agg.cum["write_ops"],
+                    "read_bytes": agg.cum["read_bytes"],
+                    "write_bytes": agg.cum["write_bytes"],
+                    "cache_hits": hits, "cache_misses": misses,
+                    "cache_hit_ratio":
+                        hits / looked if looked else 0.0,
+                    "ec_decodes": agg.cum["ec_decodes"],
+                    "errors": agg.cum["errors"],
+                    "read_ops_per_second":
+                        agg.rates["read_ops"] * decay,
+                    "write_ops_per_second":
+                        agg.rates["write_ops"] * decay,
+                    "read_bytes_per_second":
+                        agg.rates["read_bytes"] * decay,
+                    "errors_per_second":
+                        agg.rates["errors"] * decay,
+                }
+                d = self._merged_locked(node, vid, read=True)
+                if d is not None and d.count:
+                    row["read_latency"] = _digest_summary(d)
+                d = self._merged_locked(node, vid, read=False)
+                if d is not None and d.count:
+                    row["write_latency"] = _digest_summary(d)
+                out[vid] = row
+            return out
+
+    def _merged_locked(self, node: _NodeAgg, vid: Optional[int],
+                       read: bool = True) -> Optional[Digest]:
+        merged: Optional[Digest] = None
+        vols: Iterable[_VolAgg] = (
+            node.volumes.values() if vid is None
+            else filter(None, [node.volumes.get(vid)]))
+        for agg in vols:
+            for _ts, rd, wd in agg.windows:
+                d = rd if read else wd
+                if d is None:
+                    continue
+                if merged is None:
+                    merged = Digest(DIGEST_CENTROIDS)
+                merged.merge(d)
+        return merged
+
+    def node_quantile(self, node_url: str, q: float,
+                      read: bool = True) -> Optional[float]:
+        """Merged latency quantile across a node's recent windows."""
+        with self._lock:
+            node = self._nodes.get(node_url)
+            if node is None:
+                return None
+            d = self._merged_locked(node, None, read=read)
+        if d is None or not d.count:
+            return None
+        v = d.quantile(q)
+        return None if math.isnan(v) else v
+
+    def cluster_median_p99(self, read: bool = True) -> Optional[float]:
+        with self._lock:
+            urls = list(self._nodes)
+        p99s = sorted(p for p in (self.node_quantile(u, 0.99, read)
+                                  for u in urls) if p is not None)
+        if not p99s:
+            return None
+        mid = len(p99s) // 2
+        if len(p99s) % 2:
+            return p99s[mid]
+        return (p99s[mid - 1] + p99s[mid]) / 2.0
+
+    # ---------------- health ----------------
+
+    def health(self, node_url: str, last_seen: float,
+               pulse_seconds: float) -> dict:
+        """Score one node 0-100 (see docs/observability.md).
+
+        ``score = 100 * (1 - stale) * (1 - err) * (1 - lat)`` where
+        ``stale`` ramps 0->1 as the last heartbeat ages from 2 to 8
+        pulses, ``err`` is 10x the decayed error fraction (capped at
+        1), and ``lat`` ramps 0->1 as the node's read p99 goes from
+        2x to 10x the cluster median. >=80 healthy, >=50 degraded,
+        else unhealthy.
+        """
+        now = self.clock()
+        pulse = max(pulse_seconds, 1e-3)
+        staleness = max(0.0, now - last_seen)
+        stale = min(1.0, max(0.0, (staleness - 2 * pulse) / (6 * pulse)))
+        reasons = []
+        if stale > 0:
+            reasons.append(f"heartbeat {staleness:.1f}s old")
+        err = 0.0
+        ops = errs = 0.0
+        with self._lock:
+            node = self._nodes.get(node_url)
+            if node is not None:
+                decay = self._decay_factor(node, now)
+                for agg in node.volumes.values():
+                    ops += (agg.rates["read_ops"]
+                            + agg.rates["write_ops"]) * decay
+                    errs += agg.rates["errors"] * decay
+        if ops > 0:
+            frac = errs / ops
+            err = min(1.0, 10.0 * frac)
+            if err > 0.01:
+                reasons.append(f"error rate {frac:.1%}")
+        lat = 0.0
+        p99 = self.node_quantile(node_url, 0.99)
+        median = self.cluster_median_p99()
+        if p99 is not None and median and median > 0:
+            ratio = p99 / median
+            lat = min(1.0, max(0.0, (ratio - 2.0) / 8.0))
+            if lat > 0:
+                reasons.append(
+                    f"read p99 {p99 * 1e3:.1f}ms = {ratio:.1f}x "
+                    f"cluster median")
+        score = round(100.0 * (1 - stale) * (1 - err) * (1 - lat))
+        verdict = ("healthy" if score >= 80 else
+                   "degraded" if score >= 50 else "unhealthy")
+        return {"score": score, "verdict": verdict, "reasons": reasons,
+                "heartbeat_age_seconds": round(staleness, 3),
+                "read_p99_seconds": p99,
+                "ops_per_second": round(ops, 3),
+                "errors_per_second": round(errs, 4)}
+
+    # ---------------- the /cluster/telemetry payload ----------------
+
+    def to_map(self, nodes_last_seen: Optional[dict] = None,
+               pulse_seconds: float = 5.0) -> dict:
+        """JSON body for ``/cluster/telemetry``. ``nodes_last_seen``
+        maps node url -> topology ``last_seen`` (health needs it)."""
+        nodes_last_seen = nodes_last_seen or {}
+        with self._lock:
+            urls = sorted(set(self._nodes) | set(nodes_last_seen))
+        nodes = {}
+        volumes: dict[str, dict] = {}
+        for url in urls:
+            vols = self.node_volumes(url)
+            with self._lock:
+                node = self._nodes.get(url)
+                snapshots = node.snapshots if node else 0
+                last_ingest = node.last_ingest if node else 0.0
+            totals = {"read_ops_per_second": 0.0,
+                      "write_ops_per_second": 0.0,
+                      "errors_per_second": 0.0}
+            for vid, row in vols.items():
+                for k in totals:
+                    totals[k] += row[k]
+                volumes.setdefault(str(vid), {})[url] = row
+            entry = {"snapshots": snapshots,
+                     "last_ingest": last_ingest,
+                     "volume_count": len(vols), **totals}
+            p99 = self.node_quantile(url, 0.99)
+            if p99 is not None:
+                entry["read_p99_seconds"] = p99
+            if url in nodes_last_seen:
+                entry["health"] = self.health(
+                    url, nodes_last_seen[url], pulse_seconds)
+            nodes[url] = entry
+        out = {"nodes": nodes, "volumes": volumes,
+               "decay_halflife_seconds": self.halflife,
+               "digest_window_seconds": self.window}
+        median = self.cluster_median_p99()
+        if median is not None:
+            out["cluster_median_read_p99_seconds"] = median
+        return out

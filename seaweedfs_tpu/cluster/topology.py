@@ -21,6 +21,7 @@ from typing import Iterable, Optional
 
 from ..storage.ec_files import ShardBits
 from ..storage.superblock import ReplicaPlacement, Ttl
+from .telemetry import ClusterTelemetry
 
 
 @dataclass
@@ -110,6 +111,9 @@ class Topology:
         self.pulse_seconds = pulse_seconds
         self.max_volume_id = 0
         self._rng = random.Random(seed)
+        #: Rolling per-node/per-volume hot-stats registry fed by the
+        #: telemetry snapshots riding heartbeats (telemetry.py).
+        self.telemetry = ClusterTelemetry()
 
     # ---------------- heartbeat ingestion ----------------
 
@@ -196,7 +200,9 @@ class Topology:
                 del self.nodes[u]
             if dead:
                 self._rebuild_indexes()
-            return dead
+        for u in dead:
+            self.telemetry.forget(u)
+        return dead
 
     def _rebuild_indexes(self) -> None:
         layouts: dict[LayoutKey, VolumeLayout] = {}

@@ -38,8 +38,9 @@ from ..storage.store import Store, StoreError
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
-from ..util import glog, security, tracing
+from ..util import glog, security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
+from . import telemetry as telemetry_mod
 from .master import _grpc_port
 from ..util import tls as tls_mod
 
@@ -112,6 +113,9 @@ class VolumeServer:
         #: so vacuum and ec.rebuild drop the volume's entries.
         self.chunk_cache = ChunkCache(ec_cache_bytes,
                                       metrics=self.metrics)
+        #: Per-volume hot stats (ops, bytes, latency digests); a
+        #: compact snapshot rides every heartbeat to the master.
+        self.telemetry = telemetry_mod.TelemetryCollector()
         self.volume_size_limit = 30 * 1024 ** 3
         self._channels: dict[str, object] = {}
         self._grpc_server = None
@@ -267,6 +271,14 @@ class VolumeServer:
             hb.ec_shards.add(id=s["id"], collection=s["collection"],
                              ec_index_bits=s["ec_index_bits"])
         hb.max_file_key = max_key
+        if telemetry_mod.enabled():
+            collections = {v["id"]: v["collection"]
+                           for v in st["volumes"]}
+            for s in st["ec_shards"]:
+                collections.setdefault(s["id"], s["collection"])
+            hb.telemetry.CopyFrom(self.telemetry.snapshot(
+                cache_counts=self.chunk_cache.per_volume_counts(),
+                collections=collections))
         return hb
 
     def _heartbeat_loop(self) -> None:
@@ -426,6 +438,7 @@ class VolumeServer:
             sp.tag(intervals_repaired=reader.intervals_repaired)
         self.metrics.counter("ec_intervals_repaired").inc(
             reader.intervals_repaired)
+        self.telemetry.record_ec_decode(volume_id)
         self.chunk_cache.put(ckey, n.data, volume=volume_id)
         return n.data
 
@@ -958,10 +971,20 @@ def _make_http_handler(vs: VolumeServer):
                 self._json(tracing.debug_payload(
                     int(q["limit"]) if "limit" in q else None))
                 return
+            if u.path == "/debug/vars":
+                self._json(varz.payload(
+                    "volume", vs.metrics,
+                    extra={"telemetry": vs.telemetry.to_map(),
+                           "cache": vs.chunk_cache.stats()}))
+                return
             t0 = time.perf_counter()
+            vid = None
+            n_read = 0
+            err = False
             try:
                 vid, fid, q = self._parse_fid()
                 data = vs.read_bytes(vid, fid, q.get("collection", ""))
+                n_read = len(data)
                 mime = ""
                 if "width" in q or "height" in q:
                     try:
@@ -985,11 +1008,14 @@ def _make_http_handler(vs: VolumeServer):
                 vs.metrics.counter("read_requests", code="404").inc()
                 self._json({"error": str(e)}, 404)
             except Exception as e:
+                err = True
                 vs.metrics.counter("read_requests", code="500").inc()
                 self._json({"error": str(e)}, 500)
             finally:
-                vs.metrics.histogram("read_seconds").observe(
-                    time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                vs.metrics.histogram("read_seconds").observe(dt)
+                if vid is not None:
+                    vs.telemetry.record_read(vid, n_read, dt, error=err)
 
         def do_HEAD(self):
             try:
@@ -1005,10 +1031,14 @@ def _make_http_handler(vs: VolumeServer):
 
         def do_POST(self):
             t0 = time.perf_counter()
+            vid = None
+            n_written = 0
+            err = False
             try:
                 vid, fid, q = self._parse_fid()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                n_written = len(body)
                 jwt = (self.headers.get("Authorization", "")
                        .removeprefix("BEARER ").strip()
                        or q.get("jwt", ""))
@@ -1029,11 +1059,15 @@ def _make_http_handler(vs: VolumeServer):
                 vs.metrics.counter("write_requests", code="404").inc()
                 self._json({"error": str(e)}, 404)
             except Exception as e:
+                err = True
                 vs.metrics.counter("write_requests", code="500").inc()
                 self._json({"error": str(e)}, 500)
             finally:
-                vs.metrics.histogram("write_seconds").observe(
-                    time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                vs.metrics.histogram("write_seconds").observe(dt)
+                if vid is not None:
+                    vs.telemetry.record_write(vid, n_written, dt,
+                                              error=err)
 
         do_PUT = do_POST
 
@@ -1112,6 +1146,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
     tls_mod.install_from_config(conf)
     tracing.configure_from(conf)
+    telemetry_mod.configure_from(conf)
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,
                   needle_map=args.index)
     store.load_existing()

@@ -29,6 +29,7 @@ from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
 from ..util import glog
 from ..util import tracing
+from ..util import varz
 from ..util.stats import Metrics
 from .s3_auth import AuthError, Identity, SigV4Verifier
 
@@ -583,6 +584,12 @@ def _make_handler(gw: S3Gateway):
         # -- verbs --
 
         def do_GET(self):
+            if urllib.parse.urlsplit(self.path).path == "/debug/vars":
+                import json
+
+                self._send(200, json.dumps(varz.payload(
+                    "s3", gw.metrics)).encode(), "application/json")
+                return
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="GET").inc()
             try:

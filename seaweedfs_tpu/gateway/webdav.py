@@ -166,6 +166,13 @@ def _make_handler(dav: WebDavServer):
 
         def do_GET(self):
             path = self._dav_path()
+            if path == "/debug/vars":
+                import json
+
+                from ..util import varz
+                self._send(200, json.dumps(varz.payload(
+                    "webdav")).encode(), "application/json")
+                return
             entry = self._lookup(path)
             if entry is None:
                 self._send(404)

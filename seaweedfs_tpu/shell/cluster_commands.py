@@ -1647,6 +1647,25 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
             env.println(f"ec volume {vid} missing shards {gaps} "
                         f"(run ec.rebuild)")
             problems += 1
+    # Node health verdicts from the telemetry plane, best-effort (an
+    # old master without /cluster/telemetry still gets the topology
+    # checks above). Only "unhealthy" counts as a problem: degraded
+    # nodes are surfaced but a busy-yet-working cluster must not fail
+    # the sweep.
+    try:
+        tele = env._master_http("/cluster/telemetry")
+    except ShellError:
+        tele = {}
+    for url in sorted(tele.get("nodes", {})):
+        h = tele["nodes"][url].get("health")
+        if not h:
+            continue
+        line = f"node {url}: {h['verdict']} (score {h['score']})"
+        if h.get("reasons"):
+            line += " — " + "; ".join(h["reasons"])
+        env.println(line)
+        if h["verdict"] == "unhealthy":
+            problems += 1
     env.println(f"cluster.check: {n_nodes} nodes, {len(vols)} volumes, "
                 f"{len(present)} ec volumes, {problems} problems")
     if problems:
@@ -1780,6 +1799,101 @@ def cmd_trace_dump(env: ClusterEnv, argv: list[str]) -> None:
                 found = True
     if not found:
         env.println("trace.dump: no completed traces")
+
+
+def _fmt_rate(v: float) -> str:
+    return f"{v:.2f}" if v < 10 else f"{v:.0f}"
+
+
+def _fmt_ms(seconds) -> str:
+    return "-" if seconds is None else f"{seconds * 1e3:.1f}"
+
+
+@cluster_command("telemetry.status")
+def cmd_telemetry_status(env: ClusterEnv, argv: list[str]) -> None:
+    """Per-node telemetry rollup from the master's /cluster/telemetry:
+    health verdict + score, decayed op/error rates, merged read p99,
+    and how many heartbeat snapshots the master has folded in."""
+    p = _parser("telemetry.status")
+    p.parse_args(argv)
+    doc = env._master_http("/cluster/telemetry")
+    nodes = doc.get("nodes", {})
+    if not nodes:
+        env.println("telemetry.status: no telemetry ingested yet "
+                    "(volume servers report on each heartbeat)")
+        return
+    for url in sorted(nodes):
+        n = nodes[url]
+        h = n.get("health") or {}
+        verdict = h.get("verdict", "unknown")
+        score = h.get("score")
+        env.println(
+            f"{url}: {verdict}"
+            + (f" (score {score})" if score is not None else "")
+            + f" volumes={n.get('volume_count', 0)}"
+            + f" read={_fmt_rate(n.get('read_ops_per_second', 0.0))}/s"
+            + f" write={_fmt_rate(n.get('write_ops_per_second', 0.0))}/s"
+            + f" err={_fmt_rate(n.get('errors_per_second', 0.0))}/s"
+            + f" read_p99={_fmt_ms(n.get('read_p99_seconds'))}ms"
+            + f" snapshots={n.get('snapshots', 0)}")
+        for reason in h.get("reasons", []):
+            env.println(f"  - {reason}")
+    median = doc.get("cluster_median_read_p99_seconds")
+    if median is not None:
+        env.println(f"cluster median read p99: {_fmt_ms(median)}ms "
+                    f"(decay halflife "
+                    f"{doc.get('decay_halflife_seconds')}s, digest "
+                    f"window {doc.get('digest_window_seconds')}s)")
+
+
+@cluster_command("volume.heatmap")
+def cmd_volume_heatmap(env: ClusterEnv, argv: list[str]) -> None:
+    """Hottest volume replicas cluster-wide: decayed read/write rates,
+    chunk-cache hit ratio and read p99 per (volume, node), with a bar
+    scaled to the hottest row."""
+    p = _parser("volume.heatmap")
+    p.add_argument("-n", type=int, default=20,
+                   help="rows to show (hottest first)")
+    p.add_argument("-sortBy", default="reads",
+                   choices=["reads", "writes", "misses", "p99"])
+    args = p.parse_args(argv)
+    doc = env._master_http("/cluster/telemetry")
+    rows = []
+    for vid, per_node in doc.get("volumes", {}).items():
+        for url, r in per_node.items():
+            rows.append({
+                "vid": vid, "node": url,
+                "collection": r.get("collection", ""),
+                "reads": r.get("read_ops_per_second", 0.0),
+                "writes": r.get("write_ops_per_second", 0.0),
+                "hits": r.get("cache_hits", 0),
+                "misses": r.get("cache_misses", 0),
+                "hit_ratio": r.get("cache_hit_ratio", 0.0),
+                "p99": (r.get("read_latency") or {}).get("p99"),
+            })
+    if not rows:
+        env.println("volume.heatmap: no telemetry ingested yet")
+        return
+    sort_key = {"reads": lambda r: r["reads"],
+                "writes": lambda r: r["writes"],
+                "misses": lambda r: r["misses"],
+                "p99": lambda r: r["p99"] or 0.0}[args.sortBy]
+    rows.sort(key=sort_key, reverse=True)
+    rows = rows[:max(1, args.n)]
+    top = max(sort_key(r) for r in rows) or 1.0
+    env.println(f"{'volume':>8} {'collection':<12} {'node':<21} "
+                f"{'reads/s':>8} {'writes/s':>8} {'hit%':>6} "
+                f"{'p99ms':>7}  heat")
+    for r in rows:
+        bar = "#" * max(1 if sort_key(r) > 0 else 0,
+                        round(20 * sort_key(r) / top))
+        looked = r["hits"] + r["misses"]
+        hitp = f"{100 * r['hit_ratio']:.0f}" if looked else "-"
+        env.println(
+            f"{r['vid']:>8} {r['collection'] or '-':<12} "
+            f"{r['node']:<21} {_fmt_rate(r['reads']):>8} "
+            f"{_fmt_rate(r['writes']):>8} {hitp:>6} "
+            f"{_fmt_ms(r['p99']):>7}  {bar}")
 
 
 def run_cluster_command(env: ClusterEnv, line: str) -> None:

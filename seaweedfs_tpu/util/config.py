@@ -63,6 +63,13 @@ enabled = true                   # false strips all span bookkeeping
 ring_size = 256                  # completed traces kept per process
 slow_threshold_seconds = 1.0     # slower roots log a span-tree line
 """,
+    "telemetry": """\
+# telemetry.toml — heartbeat-carried per-volume hot stats
+# (docs/observability.md). Applies to volume servers; the master's
+# registry always accepts whatever snapshots arrive.
+[telemetry]
+enabled = true                   # false makes the collector a no-op
+""",
 }
 
 

@@ -72,6 +72,210 @@ class Histogram:
             self.n += 1
 
 
+class Digest:
+    """Mergeable fixed-size quantile sketch (centroid compaction).
+
+    A simplified t-digest (Dunning & Ertl): samples are buffered, then
+    compacted into at most ``max_centroids`` (mean, weight) pairs with
+    a uniform per-centroid weight cap (the merging digest's ``k0``
+    scale function), so no single centroid can smear more than
+    ``~2/max_centroids`` of the rank space — the property that keeps
+    body quantiles honest even on bimodal latency data where most of
+    the mass piles into one narrow mode. Unlike a histogram the sketch
+    is bucket-free, so digests produced on different servers can be
+    shipped (proto/JSON) and :meth:`merge`\\ d at the master, and
+    ``quantile(0.99)`` still interpolates real sample positions instead
+    of bucket edges; 64 centroids bounds the rank error near 1.5% while
+    the wire size stays ~1 KiB.
+
+    Thread-safe; all public methods take the internal lock.
+    """
+
+    __slots__ = ("max_centroids", "_means", "_weights", "_buf",
+                 "min", "max", "count", "sum", "_lock")
+
+    def __init__(self, max_centroids: int = 64):
+        if max_centroids < 2:
+            raise ValueError("max_centroids must be >= 2")
+        self.max_centroids = int(max_centroids)
+        self._means: list[float] = []
+        self._weights: list[float] = []
+        self._buf: list[float] = []
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    # -- ingest ---------------------------------------------------
+
+    def add(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._buf.append(value)
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+            if len(self._buf) >= self.max_centroids:
+                self._compact_locked()
+
+    def merge(self, other: "Digest") -> None:
+        """Fold ``other`` into this digest (other is not modified)."""
+        with other._lock:
+            means = list(other._means) + list(other._buf)
+            weights = list(other._weights) + [1.0] * len(other._buf)
+            omin, omax = other.min, other.max
+            ocount, osum = other.count, other.sum
+        if not ocount:
+            return
+        with self._lock:
+            self._compact_locked()
+            self._means += means
+            self._weights += weights
+            self.count += ocount
+            self.sum += osum
+            if omin < self.min:
+                self.min = omin
+            if omax > self.max:
+                self.max = omax
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Fold the sample buffer in and merge nearest centroid pairs
+        until at most ``max_centroids`` remain."""
+        if self._buf:
+            self._means += self._buf
+            self._weights += [1.0] * len(self._buf)
+            self._buf = []
+        n = len(self._means)
+        if n <= self.max_centroids:
+            if n > 1 and any(self._means[i] > self._means[i + 1]
+                             for i in range(n - 1)):
+                pairs = sorted(zip(self._means, self._weights))
+                self._means = [m for m, _ in pairs]
+                self._weights = [w for _, w in pairs]
+            return
+        pairs = sorted(zip(self._means, self._weights))
+        # One merge pass with a uniform weight cap: accumulate adjacent
+        # centroids while the running group stays under 2*total/k. A
+        # closest-gap policy would instead pile dense-mode mass into
+        # one mega-centroid and wreck mid-range quantiles on skewed
+        # data; the cap bounds every centroid's rank footprint.
+        total = sum(w for _, w in pairs)
+        cap = 2.0 * total / self.max_centroids
+        out: list[tuple[float, float]] = []
+        m_acc, w_acc = pairs[0]
+        for m, w in pairs[1:]:
+            if w_acc + w <= cap:
+                m_acc = (m_acc * w_acc + m * w) / (w_acc + w)
+                w_acc += w
+            else:
+                out.append((m_acc, w_acc))
+                m_acc, w_acc = m, w
+        out.append((m_acc, w_acc))
+        pairs = out
+        while len(pairs) > self.max_centroids:
+            # rare fallback (pathological weight layouts): merge the
+            # closest adjacent pair until the budget holds
+            best, gap = 0, float("inf")
+            for i in range(len(pairs) - 1):
+                d = pairs[i + 1][0] - pairs[i][0]
+                if d < gap:
+                    best, gap = i, d
+            (m1, w1), (m2, w2) = pairs[best], pairs[best + 1]
+            w = w1 + w2
+            pairs[best:best + 2] = [((m1 * w1 + m2 * w2) / w, w)]
+        self._means = [m for m, _ in pairs]
+        self._weights = [w for _, w in pairs]
+
+    # -- query ----------------------------------------------------
+
+    def quantile(self, q: float) -> float:
+        """Estimate the ``q``-quantile (0 <= q <= 1); NaN when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        with self._lock:
+            self._compact_locked()
+            if not self._means:
+                return float("nan")
+            if len(self._means) == 1:
+                return self._means[0]
+            total = sum(self._weights)
+            target = q * total
+            # centroid i covers the cumulative-weight interval around
+            # its midpoint; interpolate between adjacent midpoints
+            cum = 0.0
+            mids = []
+            for m, w in zip(self._means, self._weights):
+                mids.append((cum + w / 2.0, m))
+                cum += w
+            # anchor the ends at the exact observed extremes
+            pts = [(0.0, self.min)] + mids + [(total, self.max)]
+            for i in range(len(pts) - 1):
+                c0, m0 = pts[i]
+                c1, m1 = pts[i + 1]
+                if target <= c1:
+                    if c1 == c0:
+                        return m1
+                    return m0 + (m1 - m0) * (target - c0) / (c1 - c0)
+            return self.max
+
+    def percentiles(self, *qs: float) -> dict[str, float]:
+        """Convenience: {"p50": ..., "p99": ...} for the given qs."""
+        return {"p" + ("%g" % (q * 100)).replace(".", "_"):
+                self.quantile(q) for q in qs}
+
+    # -- wire formats ---------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            self._compact_locked()
+            return {
+                "means": list(self._means),
+                "weights": list(self._weights),
+                "min": self.min if self.count else 0.0,
+                "max": self.max if self.count else 0.0,
+                "count": self.count,
+                "sum": self.sum,
+            }
+
+    @classmethod
+    def from_dict(cls, d: dict,
+                  max_centroids: int = 64) -> "Digest":
+        dg = cls(max_centroids=max_centroids)
+        dg._means = [float(m) for m in d.get("means", ())]
+        dg._weights = [float(w) for w in d.get("weights", ())]
+        dg.count = int(d.get("count", 0))
+        dg.sum = float(d.get("sum", 0.0))
+        if dg.count:
+            dg.min = float(d["min"])
+            dg.max = float(d["max"])
+        return dg
+
+    def to_proto(self):
+        """Fill a fresh ``master_pb.DigestMessage`` (lazy import keeps
+        stats.py usable without the pb package)."""
+        from seaweedfs_tpu.pb import master_pb2
+
+        d = self.to_dict()
+        msg = master_pb2.DigestMessage(
+            centroid_means=d["means"], centroid_weights=d["weights"],
+            min=d["min"], max=d["max"], count=d["count"], sum=d["sum"])
+        return msg
+
+    @classmethod
+    def from_proto(cls, msg, max_centroids: int = 64) -> "Digest":
+        return cls.from_dict(
+            {"means": list(msg.centroid_means),
+             "weights": list(msg.centroid_weights),
+             "min": msg.min, "max": msg.max,
+             "count": msg.count, "sum": msg.sum},
+            max_centroids=max_centroids)
+
+
 class Metrics:
     """One registry per server process."""
 

@@ -45,6 +45,11 @@ METRICS = stats.Metrics(namespace="trace")
 _ENABLED = True
 _SLOW_THRESHOLD = 1.0
 _RING: deque = deque(maxlen=256)
+#: Slow-request ring: compact summaries of every trace that crossed
+#: the slow threshold, served by each server's ``/debug/vars``. Kept
+#: separate from ``_RING`` so slow outliers survive long after the
+#: main ring has churned past them.
+_SLOW_RING: deque = deque(maxlen=64)
 
 #: HTTP paths never traced — scrapes and debug polls would otherwise
 #: flood the ring buffer with single-span traces.
@@ -161,6 +166,7 @@ def slow_threshold() -> float:
 def reset() -> None:
     """Drop ring-buffer contents and this thread's state (tests)."""
     _RING.clear()
+    _SLOW_RING.clear()
     _STATE.stack = []
     _STATE.finished = []
 
@@ -258,8 +264,16 @@ def _finish(sp: Span, exc: Optional[BaseException]) -> None:
             _record(s)
         _RING.append((sp, spans))  # dict form built lazily on read
         if sp.duration >= _SLOW_THRESHOLD:
+            summary = summarize_spans(spans)
+            _SLOW_RING.append({
+                "ts": sp.end, "trace_id": sp.trace_id,
+                "name": sp.name,
+                "duration_seconds": round(sp.duration, 6),
+                "status": sp.status, "spans": len(spans),
+                "summary": summary,
+            })
             glog.warning("slow trace %s %s %.3fs: %s", sp.trace_id,
-                         sp.name, sp.duration, summarize_spans(spans))
+                         sp.name, sp.duration, summary)
 
 
 class _SpanHandle:
@@ -352,6 +366,15 @@ def recent_traces(limit: Optional[int] = None) -> list[dict]:
     if limit is not None and limit >= 0:
         entries = entries[-limit:] if limit else []
     return [_bundle(root, spans) for root, spans in entries]
+
+
+def slow_requests(limit: Optional[int] = None) -> list[dict]:
+    """Most recent slow-trace summaries, newest last (the
+    ``/debug/vars`` slow-request ring)."""
+    entries = list(_SLOW_RING)
+    if limit is not None and limit >= 0:
+        entries = entries[-limit:] if limit else []
+    return entries
 
 
 def debug_payload(limit: Optional[int] = None) -> dict:

@@ -1,0 +1,76 @@
+"""``/debug/vars`` payload — the Go ``expvar`` analog.
+
+Every HTTP server (master, volume, filer, S3, WebDAV) serves one JSON
+document with process vitals (pid, uptime, RSS, CPU, threads, fds, GC)
+plus the tracing slow-request ring, so "what is this process doing" is
+one curl away without a metrics stack. Callers pass ``extra`` for
+role-specific sections (the volume server attaches its telemetry
+collector, the master its cluster registry).
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from . import tracing
+from .stats import Metrics
+
+try:
+    import resource
+except ImportError:  # non-unix: the /proc vitals still apply
+    resource = None  # type: ignore[assignment]
+
+_START_TIME = time.time()
+
+
+def _rss_bytes() -> Optional[int]:
+    # /proc is authoritative on linux; ru_maxrss is a peak, not current
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _open_fds() -> Optional[int]:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def payload(component: str, metrics: Optional[Metrics] = None,
+            extra: Optional[dict] = None) -> dict:
+    out = {
+        "component": component,
+        "pid": os.getpid(),
+        "start_time": _START_TIME,
+        "uptime_seconds": round(time.time() - _START_TIME, 3),
+        "python_version": sys.version.split()[0],
+        "argv": sys.argv,
+        "threads": threading.active_count(),
+        "gc_counts": gc.get_count(),
+        "slow_requests": tracing.slow_requests(),
+    }
+    rss = _rss_bytes()
+    if rss is not None:
+        out["rss_bytes"] = rss
+    fds = _open_fds()
+    if fds is not None:
+        out["open_fds"] = fds
+    if resource is not None:
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        out["user_cpu_seconds"] = ru.ru_utime
+        out["system_cpu_seconds"] = ru.ru_stime
+    if metrics is not None:
+        with metrics._lock:
+            out["metric_series"] = len(metrics._metrics)
+        out["metrics_namespace"] = metrics.namespace
+    if extra:
+        out.update(extra)
+    return out

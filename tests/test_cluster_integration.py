@@ -284,3 +284,66 @@ def test_trace_propagation_filer_volume_read(cluster):
                               for t in remote)
     finally:
         filer.stop()
+
+
+def test_telemetry_reaches_master_within_two_heartbeats(cluster):
+    """Per-volume hot stats from a real read load must be visible at
+    the master's /cluster/telemetry within two heartbeats (the ISSUE's
+    acceptance bar), carrying read counts, cache counters, latency
+    percentiles, and a health verdict per node."""
+    import json
+
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        payloads = [bytes([40 + i]) * 2000 for i in range(8)]
+        fids = operation.submit(mc, payloads)
+        vid = int(fids[0].split(",")[0])
+        for _ in range(2):
+            for fid, want in zip(fids, payloads):
+                assert operation.download(mc, fid) == want
+        for vs in servers:
+            vs.heartbeat_now()
+
+        deadline = time.time() + 2 * PULSE + 5
+        doc, per_node = {}, {}
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://{master.url}/cluster/telemetry") as r:
+                doc = json.loads(r.read())
+            per_node = doc.get("volumes", {}).get(str(vid), {})
+            if sum(row["read_ops"]
+                   for row in per_node.values()) >= 2:
+                break
+            time.sleep(0.05)
+        assert per_node, f"volume {vid} never appeared: {doc}"
+
+        rows = list(per_node.values())
+        assert sum(r["read_ops"] for r in rows) >= 2
+        assert sum(r["read_bytes"] for r in rows) >= 2000
+        busiest = max(rows, key=lambda r: r["read_ops"])
+        assert "cache_hit_ratio" in busiest
+        assert busiest["read_latency"]["count"] >= 2
+        assert busiest["read_latency"]["p99"] > 0.0
+        assert busiest["read_ops_per_second"] > 0.0
+
+        for url, entry in doc["nodes"].items():
+            h = entry.get("health")
+            assert h and h["verdict"] in (
+                "healthy", "degraded", "unhealthy"), (url, entry)
+
+        # the master's gauges follow the ingested snapshots
+        with urllib.request.urlopen(
+                f"http://{master.url}/metrics") as r:
+            text = r.read().decode()
+        assert "master_telemetry_node_read_ops_per_second" in text
+        assert "master_telemetry_volume_cache_hit_ratio" in text
+
+        # each volume server's /debug/vars shows its local collector
+        with urllib.request.urlopen(
+                f"http://{servers[0].url}/debug/vars") as r:
+            vars_doc = json.loads(r.read())
+        assert vars_doc["component"] == "volume"
+        assert "telemetry" in vars_doc and "cache" in vars_doc
+    finally:
+        mc.close()

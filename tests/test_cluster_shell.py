@@ -818,3 +818,35 @@ def test_fix_replication_prefers_rack_diversity_and_check_flags(cluster):
         env.close()
     finally:
         mc.close()
+
+
+def test_shell_telemetry_commands(cluster):
+    """telemetry.status, volume.heatmap and the cluster.check health
+    verdicts all render from a live cluster's telemetry plane."""
+    master, servers = cluster
+    mc = MasterClient(master.url)
+    try:
+        payloads = [bytes([50 + i]) * 1500 for i in range(6)]
+        fids = operation.submit(mc, payloads)
+        for fid, want in zip(fids, payloads):
+            assert operation.download(mc, fid) == want
+        _settle(servers)
+        time.sleep(0.1)
+
+        env, out = _env(master)
+        run_cluster_command(env, "telemetry.status")
+        text = out.getvalue()
+        assert "score" in text and "read=" in text, text
+        assert "snapshots=" in text
+
+        run_cluster_command(env, "volume.heatmap -n 5")
+        text = out.getvalue()
+        assert "reads/s" in text and "#" in text, text
+
+        run_cluster_command(env, "cluster.check")
+        text = out.getvalue()
+        assert "healthy (score" in text, text
+        assert "0 problems" in text
+        env.close()
+    finally:
+        mc.close()

@@ -1,0 +1,394 @@
+"""Telemetry plane units: the mergeable Digest sketch, the volume-side
+TelemetryCollector, the master-side ClusterTelemetry registry (decay,
+health scoring), chunk-cache per-volume counters, and /debug/vars."""
+
+import json
+import math
+import random
+
+import pytest
+
+from seaweedfs_tpu.cache.chunk_cache import ChunkCache, key_volume
+from seaweedfs_tpu.cluster import telemetry
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.util import varz
+from seaweedfs_tpu.util.stats import Digest, Metrics
+
+
+# ------------- Digest -------------
+
+def _true_quantile(sorted_vals, q):
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = idx - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def test_digest_empty():
+    d = Digest()
+    assert d.count == 0
+    assert math.isnan(d.quantile(0.5))
+    # merging an empty digest is a no-op
+    e = Digest()
+    e.merge(d)
+    assert e.count == 0 and math.isnan(e.quantile(0.99))
+
+
+def test_digest_one_sample():
+    d = Digest()
+    d.add(0.125)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert d.quantile(q) == 0.125
+    assert d.min == d.max == 0.125
+    assert d.count == 1 and d.sum == 0.125
+
+
+def test_digest_exact_extremes():
+    d = Digest(max_centroids=8)
+    for v in range(1000):
+        d.add(v / 10.0)
+    assert d.quantile(0.0) == 0.0
+    assert d.quantile(1.0) == 99.9
+    assert d.count == 1000
+    assert d.sum == pytest.approx(sum(v / 10.0 for v in range(1000)))
+
+
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "bimodal"])
+def test_digest_quantile_accuracy_vs_sorted_reference(dist):
+    """Digest quantiles must land near truth by EITHER yardstick:
+    within 0.05 rank error (right for heavy tails, where values
+    explode) or within 10% relative value error (right inside dense
+    modes, where a tiny value nudge is many ranks wide)."""
+    rng = random.Random(42)
+    if dist == "uniform":
+        vals = [rng.random() for _ in range(5000)]
+    elif dist == "lognormal":
+        vals = [rng.lognormvariate(0.0, 2.0) for _ in range(5000)]
+    else:  # bimodal: fast cache hits + slow disk reads
+        vals = [rng.gauss(0.001, 0.0001) if rng.random() < 0.9
+                else rng.gauss(0.050, 0.005) for _ in range(5000)]
+    d = Digest(max_centroids=64)
+    for v in vals:
+        d.add(v)
+    vals.sort()
+    for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+        est = d.quantile(q)
+        true = _true_quantile(vals, q)
+        lo = _true_quantile(vals, max(0.0, q - 0.05))
+        hi = _true_quantile(vals, min(1.0, q + 0.05))
+        assert lo <= est <= hi or \
+            abs(est - true) <= 0.10 * abs(true), \
+            f"{dist} q={q}: {est} vs true {true} (band [{lo}, {hi}])"
+
+
+def test_digest_merge_matches_single_digest():
+    """Merging shards must track a single digest over the union, and
+    merge order must not matter beyond sketch tolerance."""
+    rng = random.Random(7)
+    shards = [[rng.expovariate(1.0) for _ in range(800)]
+              for _ in range(3)]
+    whole = Digest()
+    parts = []
+    for shard in shards:
+        p = Digest()
+        for v in shard:
+            p.add(v)
+            whole.add(v)
+        parts.append(p)
+
+    def merged(order):
+        m = Digest()
+        for i in order:
+            m.merge(parts[i])
+        return m
+
+    a = merged([0, 1, 2])
+    b = merged([2, 0, 1])
+    total = sum(len(s) for s in shards)
+    allv = sorted(v for s in shards for v in s)
+    for m in (a, b):
+        assert m.count == total
+        assert m.min == allv[0] and m.max == allv[-1]
+        assert m.sum == pytest.approx(whole.sum)
+    for q in (0.5, 0.95, 0.99):
+        lo = _true_quantile(allv, max(0.0, q - 0.05))
+        hi = _true_quantile(allv, min(1.0, q + 0.05))
+        for m in (a, b, whole):
+            assert lo <= m.quantile(q) <= hi
+        # the two merge orders agree with each other tightly
+        assert a.quantile(q) == pytest.approx(b.quantile(q), rel=0.25)
+
+
+def test_digest_proto_and_dict_round_trip():
+    d = Digest(max_centroids=16)
+    rng = random.Random(1)
+    for _ in range(500):
+        d.add(rng.random())
+    for back in (Digest.from_proto(d.to_proto(), max_centroids=16),
+                 Digest.from_dict(json.loads(json.dumps(d.to_dict())),
+                                  max_centroids=16)):
+        assert back.count == d.count
+        assert back.min == d.min and back.max == d.max
+        assert back.sum == pytest.approx(d.sum)
+        for q in (0.5, 0.99):
+            assert back.quantile(q) == pytest.approx(d.quantile(q))
+    # an empty digest survives the round trip too
+    e = Digest.from_proto(Digest().to_proto())
+    assert e.count == 0 and math.isnan(e.quantile(0.5))
+
+
+def test_digest_bounded_size():
+    d = Digest(max_centroids=32)
+    for i in range(10_000):
+        d.add(float(i))
+    msg = d.to_proto()
+    assert len(msg.centroid_means) <= 32
+    assert msg.count == 10_000
+
+
+# ------------- TelemetryCollector (volume-server side) -------------
+
+def test_collector_snapshot_cumulative_counters_drained_digests():
+    c = telemetry.TelemetryCollector()
+    for _ in range(10):
+        c.record_read(3, 1000, 0.002)
+    c.record_write(3, 500, 0.004)
+    c.record_read(3, 0, 0.5, error=True)
+    c.record_ec_decode(7, n=2)
+
+    snap = c.snapshot(cache_counts={3: {"hits": 8, "misses": 3}},
+                      collections={3: "photos"})
+    by_vid = {v.volume_id: v for v in snap.volumes}
+    v3 = by_vid[3]
+    assert v3.collection == "photos"
+    assert v3.read_ops == 11 and v3.write_ops == 1
+    assert v3.read_bytes == 10_000 and v3.write_bytes == 500
+    assert v3.cache_hits == 8 and v3.cache_misses == 3
+    assert v3.errors == 1
+    assert v3.read_latency.count == 11
+    assert by_vid[7].ec_decodes == 2
+    assert snap.window_ns >= 0
+
+    # heartbeats round-trip through the wire
+    hb = master_pb2.Heartbeat(ip="127.0.0.1", port=8080)
+    hb.telemetry.CopyFrom(snap)
+    hb2 = master_pb2.Heartbeat.FromString(hb.SerializeToString())
+    assert hb2.HasField("telemetry")
+    assert hb2.telemetry.volumes[0].read_ops == 11
+
+    # counters stay cumulative across snapshots; digests are drained
+    c.record_read(3, 100, 0.001)
+    snap2 = c.snapshot()
+    v3b = {v.volume_id: v for v in snap2.volumes}[3]
+    assert v3b.read_ops == 12
+    assert v3b.read_latency.count == 1  # only the new window's sample
+
+
+def test_collector_disabled_is_a_noop():
+    c = telemetry.TelemetryCollector()
+    telemetry.configure(enabled=False)
+    try:
+        assert not telemetry.enabled()
+        c.record_read(1, 100, 0.001)
+        c.record_write(1, 100, 0.001)
+        c.record_ec_decode(1)
+        assert not c.snapshot().volumes
+    finally:
+        telemetry.configure(enabled=True)
+    assert telemetry.enabled()
+
+
+def test_configure_from_config_section():
+    telemetry.configure_from({"telemetry": {"enabled": False}})
+    try:
+        assert not telemetry.enabled()
+    finally:
+        telemetry.configure(enabled=True)
+    # absent/malformed sections leave the flag alone
+    telemetry.configure_from({})
+    telemetry.configure_from({"telemetry": "nope"})
+    assert telemetry.enabled()
+
+
+# ------------- ClusterTelemetry (master side) -------------
+
+def _snap(read_ops=0, write_ops=0, errors=0, vid=1, lat=None):
+    s = master_pb2.TelemetrySnapshot(window_ns=1_000_000_000)
+    v = s.volumes.add(volume_id=vid, read_ops=read_ops,
+                      write_ops=write_ops, errors=errors,
+                      cache_hits=read_ops // 2, cache_misses=read_ops)
+    if lat is not None:
+        d = Digest()
+        for x in lat:
+            d.add(x)
+        v.read_latency.CopyFrom(d.to_proto())
+    return s
+
+
+def test_registry_rates_and_decay():
+    now = [1000.0]
+    reg = telemetry.ClusterTelemetry(halflife=10.0, window=60.0,
+                                     clock=lambda: now[0])
+    reg.ingest("n1", _snap(read_ops=0))
+    now[0] += 10.0
+    reg.ingest("n1", _snap(read_ops=100, lat=[0.001] * 50))
+    row = reg.node_volumes("n1")[1]
+    assert row["read_ops"] == 100
+    # 100 ops over 10s folded with alpha=0.5 -> 5 ops/s
+    assert row["read_ops_per_second"] == pytest.approx(5.0, rel=0.01)
+    assert row["cache_hit_ratio"] == pytest.approx(50 / 150)
+    assert row["read_latency"]["count"] == 50
+
+    # no further ingests: the decayed view falls toward zero
+    now[0] += 20.0  # two half-lives
+    decayed = reg.node_volumes("n1")[1]["read_ops_per_second"]
+    assert decayed == pytest.approx(5.0 / 4, rel=0.01)
+
+
+def test_registry_counter_regression_is_a_restart():
+    now = [0.0]
+    reg = telemetry.ClusterTelemetry(halflife=10.0,
+                                     clock=lambda: now[0])
+    reg.ingest("n1", _snap(read_ops=1000))
+    before = reg.node_volumes("n1")[1]["read_ops_per_second"]
+    now[0] += 10.0
+    # server restarted: cumulative counter fell to 30. The regression
+    # must read as "30 new ops", never as a -970 delta.
+    reg.ingest("n1", _snap(read_ops=30))
+    row = reg.node_volumes("n1")[1]
+    assert row["read_ops"] == 30
+    assert 0.0 <= row["read_ops_per_second"] < before
+
+
+def test_registry_windows_prune_and_forget():
+    now = [0.0]
+    reg = telemetry.ClusterTelemetry(halflife=10.0, window=30.0,
+                                     clock=lambda: now[0])
+    reg.ingest("n1", _snap(read_ops=10, lat=[0.010] * 20))
+    assert reg.node_quantile("n1", 0.5) == pytest.approx(0.010, rel=0.1)
+    now[0] += 31.0  # past the digest window
+    reg.ingest("n1", _snap(read_ops=10))
+    assert reg.node_quantile("n1", 0.5) is None
+    reg.forget("n1")
+    assert reg.node_volumes("n1") == {}
+    assert reg.node_quantile("n1", 0.5) is None
+
+
+def test_health_scoring_and_verdicts():
+    now = [100.0]
+    reg = telemetry.ClusterTelemetry(halflife=60.0,
+                                     clock=lambda: now[0])
+    # a fresh, error-free node is healthy
+    reg.ingest("good", _snap(read_ops=100, lat=[0.002] * 30))
+    h = reg.health("good", last_seen=now[0], pulse_seconds=5.0)
+    assert h["verdict"] == "healthy" and h["score"] >= 95
+
+    # heartbeat 8+ pulses stale -> stale factor saturates -> unhealthy
+    h = reg.health("good", last_seen=now[0] - 60.0, pulse_seconds=5.0)
+    assert h["verdict"] == "unhealthy" and h["score"] == 0
+    assert any("heartbeat" in r for r in h["reasons"])
+
+    # heavy error fraction drags the score down
+    now[0] += 5.0
+    reg.ingest("bad", _snap(read_ops=100, errors=50))
+    h = reg.health("bad", last_seen=now[0], pulse_seconds=5.0)
+    assert h["score"] < 80
+    assert any("error rate" in r for r in h["reasons"])
+
+    # tail-latency outlier vs the cluster median
+    now[0] += 5.0
+    reg.ingest("slow", _snap(read_ops=100, lat=[0.200] * 30))
+    for extra in ("a", "b"):  # median anchored by fast nodes
+        reg.ingest(extra, _snap(read_ops=10, lat=[0.002] * 30))
+    h = reg.health("slow", last_seen=now[0], pulse_seconds=5.0)
+    assert any("cluster median" in r for r in h["reasons"])
+    assert h["score"] < 80
+
+
+def test_registry_to_map_and_gauges():
+    now = [0.0]
+    reg = telemetry.ClusterTelemetry(halflife=10.0,
+                                     clock=lambda: now[0])
+    m = Metrics(namespace="master")
+    reg.ingest("n1", _snap(read_ops=50, lat=[0.003] * 40), metrics=m)
+    doc = reg.to_map(nodes_last_seen={"n1": now[0]}, pulse_seconds=5.0)
+    assert "n1" in doc["nodes"]
+    assert doc["nodes"]["n1"]["health"]["verdict"] == "healthy"
+    assert doc["volumes"]["1"]["n1"]["read_ops"] == 50
+    assert "read_p99_seconds" in doc["nodes"]["n1"]
+    json.dumps(doc)  # the whole payload must be JSON-able
+    text = m.render()
+    assert 'telemetry_volume_read_ops_per_second{node="n1",volume="1"}' \
+        in text
+    assert 'telemetry_node_read_p99_seconds{node="n1"}' in text
+
+
+# ------------- chunk-cache per-volume counters -------------
+
+def test_chunk_cache_per_volume_counts_and_cardinality_cap():
+    cache = ChunkCache(capacity_bytes=1 << 20,
+                       metrics=Metrics(namespace="cc_test"))
+    assert key_volume("chunk:127.0.0.1:9333:3,01637037d6") == 3
+    assert key_volume("ec:7:3,01637037d6") == 7
+    assert key_volume("5,01637037d6") == 5
+    assert key_volume("dav:/x/y:deadbeef") is None
+
+    cache.put("chunk:m:3,01abc", b"x" * 100, volume=3)
+    assert cache.get("chunk:m:3,01abc") == b"x" * 100   # hit on vol 3
+    assert cache.get("chunk:m:4,02def") is None          # miss on vol 4
+    counts = cache.per_volume_counts()
+    assert counts[3]["hits"] == 1
+    assert counts[4]["misses"] == 1
+
+    # the label space is capped: distinct volumes beyond the cap share
+    # the "other" bucket and never mint per-volume counters
+    cap = cache._vol_label_cap
+    for vid in range(10, 10 + cap + 50):
+        cache.get(f"chunk:m:{vid},01")
+    counts = cache.per_volume_counts()
+    assert len(counts) <= cap
+    assert len(cache._vol_counters) <= 3 * cap  # hits/misses/rejects
+
+
+def test_chunk_cache_metrics_render_volume_labels():
+    cache = ChunkCache(capacity_bytes=1 << 20,
+                       metrics=Metrics(namespace="cc_test2"))
+    cache.put("chunk:m:9,01abc", b"y" * 64, volume=9)
+    cache.get("chunk:m:9,01abc")
+    text = cache.metrics.render()
+    assert 'volume="9"' in text
+
+
+# ------------- /debug/vars payload -------------
+
+def test_varz_payload_shape():
+    m = Metrics(namespace="t")
+    m.counter("x_total").inc()
+    doc = varz.payload("tester", m, extra={"answer": 42})
+    for key in ("component", "pid", "start_time", "uptime_seconds",
+                "python_version", "threads", "gc_counts",
+                "slow_requests"):
+        assert key in doc, key
+    assert doc["component"] == "tester"
+    assert doc["answer"] == 42
+    assert doc["metric_series"] >= 1
+    json.dumps(doc)  # must be JSON-able as served
+
+
+def test_varz_includes_slow_requests_from_tracing():
+    from seaweedfs_tpu.util import tracing
+    tracing.reset()
+    tracing.configure(enabled=True, slow_threshold_seconds=0.0)
+    try:
+        with tracing.start_trace("tele-slow-op"):
+            pass
+        doc = varz.payload("tester")
+        names = [r["name"] for r in doc["slow_requests"]]
+        assert "tele-slow-op" in names
+        row = doc["slow_requests"][names.index("tele-slow-op")]
+        assert row["duration_seconds"] >= 0.0
+        assert row["trace_id"]
+    finally:
+        tracing.reset()
+        tracing.configure(enabled=True, slow_threshold_seconds=1.0)
