@@ -60,6 +60,7 @@ CFG5_TIMEOUT = 420
 CACHE_TIMEOUT = 180      # chunk-cache zipfian stage (pure CPU, no jax)
 TRACE_TIMEOUT = 300      # tracing-overhead stage (CPU mini cluster)
 TELEMETRY_TIMEOUT = 300  # telemetry-overhead stage (CPU mini cluster)
+FAULT_TIMEOUT = 300      # fault-point-overhead stage (CPU mini cluster)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -226,6 +227,12 @@ def parent() -> None:
     rc, out = _run(["--child-telemetry-overhead"], _scrubbed_env(),
                    TELEMETRY_TIMEOUT)
     stage_platforms["telemetry"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Disabled fault-point tax on the same path — same design.
+    rc, out = _run(["--child-fault-overhead"], _scrubbed_env(),
+                   FAULT_TIMEOUT)
+    stage_platforms["fault"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1566,7 +1573,21 @@ from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.util import tracing
 
-plane = tracing if sys.argv[2] == "tracing" else telemetry
+if sys.argv[2] == "tracing":
+    plane = tracing
+elif sys.argv[2] == "telemetry":
+    plane = telemetry
+else:  # "faults": on = armed-but-inert spec, so every fault point in
+    # the read path pays the real armed cost (dict lookup miss) while
+    # injecting nothing; off = the disarmed single-flag fast path.
+    from seaweedfs_tpu.util import faults as _faults
+    class plane:
+        @staticmethod
+        def configure(enabled):
+            if enabled:
+                _faults.inject("bench.noop", "delay:0@0")
+            else:
+                _faults.clear()
 
 def fpp():
     for _ in range(50):
@@ -1643,14 +1664,29 @@ def _measure_plane_overhead(plane: str) -> tuple:
 
         block(60)  # warm: chunk cache resident, lookups cached
         lat = {"off": [], "on": []}
-        for rnd in range(8):
+        diffs = []
+        for rnd in range(24):
             order = ("off", "on") if rnd % 2 == 0 else ("on", "off")
+            rmed = {}
             for mode in order:
                 set_mode(mode)
-                block(20)
-                lat[mode] += block(150)
-        return (statistics.median(lat["off"]),
-                statistics.median(lat["on"]))
+                block(30)
+                samples = block(150)
+                lat[mode] += samples
+                rmed[mode] = statistics.median(samples)
+            diffs.append(rmed["on"] - rmed["off"])
+        # The planes under test cost well under the run-to-run drift of
+        # a localhost HTTP read, so estimate the DIFFERENCE from paired
+        # adjacent blocks (drift cancels within a round; alternating
+        # order cancels within-round drift across rounds) instead of
+        # subtracting two noisy grand medians; the interquartile mean
+        # of the round diffs sheds lag-spike tails without the
+        # inefficiency of a lone median.
+        diffs.sort()
+        q = len(diffs) // 4
+        delta = statistics.fmean(diffs[q:len(diffs) - q])
+        t_off = statistics.median(lat["off"])
+        return (t_off, t_off + delta)
     finally:
         proc.kill()
         proc.wait()
@@ -1707,6 +1743,32 @@ def child_telemetry_overhead() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_fault_overhead() -> None:
+    """Fault-injection-plane tax on the cached-read path when NOTHING
+    is injected (docs/robustness.md).
+
+    Same harness as the trace/telemetry stages. "off" is the default
+    disarmed state (every ``faults.check`` is one module-flag test);
+    "on" arms a never-firing spec at an unused point, which is the
+    worst armed-but-quiet case: every real fault point in the read
+    path now also pays the specs-dict lookup miss.
+    Acceptance (ISSUE 5): overhead < 2%."""
+    t_off, t_on = _measure_plane_overhead("faults")
+    overhead = (t_on - t_off) / t_off
+    res = {
+        "fault_overhead_pct": round(overhead * 100, 2),
+        "fault_read_us_off": round(t_off * 1e6, 1),
+        "fault_read_us_on": round(t_on * 1e6, 1),
+        "fault_overhead_ok": bool(overhead < 0.02),
+    }
+    log(f"fault stage: cached read {res['fault_read_us_off']}us "
+        f"off / {res['fault_read_us_on']}us on -> "
+        f"{res['fault_overhead_pct']}% overhead "
+        f"({'OK' if res['fault_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -1729,5 +1791,8 @@ if __name__ == "__main__":
     elif ("--child-telemetry-overhead" in sys.argv
           or "--telemetry-overhead" in sys.argv):
         child_telemetry_overhead()
+    elif ("--child-fault-overhead" in sys.argv
+          or "--fault-overhead" in sys.argv):
+        child_fault_overhead()
     else:
         parent()

@@ -15,12 +15,12 @@ import threading
 import time
 import urllib.error
 import urllib.parse
-import urllib.request
 from typing import Iterator, Optional
 
 from .. import pb
 from ..pb import filer_pb2
 from .master import _grpc_port
+from ..util import faults, retry
 from ..util import tls as tls_mod
 from ..util import tracing
 
@@ -48,6 +48,7 @@ class FilerClient:
     def _stub(self) -> pb.Stub:
         import grpc
 
+        faults.check("filer.meta")  # every metadata RPC passes here
         with self._lock:
             if self._channel is None:
                 ip, http_port = self.filer_url.rsplit(":", 1)
@@ -138,33 +139,30 @@ class FilerClient:
     def put_data(self, path: str, data: bytes, mime: str = "",
                  query: str = "", signatures: tuple = ()) -> dict:
         query = _with_signatures(query, signatures)
-        req = urllib.request.Request(self._url(path, query), data=data,
-                                     method="PUT",
-                                     headers=tracing.inject({}))
-        if mime:
-            req.add_header("Content-Type", mime)
+        headers = {"Content-Type": mime} if mime else None
         try:
             with tracing.span("filer.put", path=path) as sp:
                 sp.n_bytes = len(data)
-                with urllib.request.urlopen(req, timeout=120) as r:
-                    return json.loads(r.read() or b"{}")
+                r = retry.http_request(self._url(path, query), data=data,
+                                       method="PUT", headers=headers,
+                                       point="filer.data", timeout=120)
+                return json.loads(r.data or b"{}")
         except urllib.error.HTTPError as e:
             raise FilerClientError(
                 f"PUT {path}: {e.code} {e.read()!r}") from e
 
     def get_data(self, path: str, offset: int = 0,
                  length: Optional[int] = None) -> bytes:
-        req = urllib.request.Request(self._url(path),
-                                     headers=tracing.inject({}))
+        headers = {}
         if offset or length is not None:
             stop = "" if length is None else str(offset + length - 1)
-            req.add_header("Range", f"bytes={offset}-{stop}")
+            headers["Range"] = f"bytes={offset}-{stop}"
         try:
             with tracing.span("filer.get", path=path) as sp:
-                with urllib.request.urlopen(req, timeout=120) as r:
-                    data = r.read()
-                sp.n_bytes = len(data)
-                return data
+                r = retry.http_request(self._url(path), headers=headers,
+                                       point="filer.data", timeout=120)
+                sp.n_bytes = len(r.data)
+                return r.data
         except urllib.error.HTTPError as e:
             err = FilerClientError(f"GET {path}: {e.code}")
             err.code = e.code  # lets callers tell 404 from transient
@@ -260,11 +258,9 @@ class FilerClient:
                     signatures: tuple = ()) -> None:
         q = _with_signatures("recursive=true" if recursive else "",
                              signatures)
-        req = urllib.request.Request(self._url(path, q), method="DELETE",
-                                     headers=tracing.inject({}))
         try:
-            with urllib.request.urlopen(req, timeout=120) as r:
-                r.read()
+            retry.http_request(self._url(path, q), method="DELETE",
+                               point="filer.data", timeout=120)
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise FilerClientError(

@@ -25,7 +25,9 @@ from ..filer.entry import Attr, Entry, FileChunk, normalize_path
 from ..filer.filechunks import total_size
 from ..filer.stores import MemoryStore, SqliteStore
 from ..pb import filer_pb2
+from ..util import faults as faults_mod
 from ..util import glog
+from ..util import retry
 from ..util import tracing
 from ..util import varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
@@ -360,7 +362,8 @@ def _make_http_handler(fs: FilerServer):
             u = urlparse(self.path)
             if u.path == "/metrics":
                 self._send(200, (fs.metrics.render()
-                                 + tracing.METRICS.render()).encode(),
+                                 + tracing.METRICS.render()
+                                 + retry.METRICS.render()).encode(),
                            EXPOSITION_CONTENT_TYPE)
                 return
             if u.path == "/debug/traces":
@@ -371,6 +374,10 @@ def _make_http_handler(fs: FilerServer):
             if u.path == "/debug/vars":
                 self._send(200, json.dumps(
                     varz.payload("filer", fs.metrics)).encode())
+                return
+            dl = retry.deadline_from_headers(self.headers)
+            if dl is not None and dl.expired():
+                self._err(504, "caller deadline already exhausted")
                 return
             path, q = self._path()
             fs.metrics.counter("request_total", method="GET").inc()
@@ -397,7 +404,13 @@ def _make_http_handler(fs: FilerServer):
             rng = _parse_range(self.headers.get("Range"), size)
             if rng is not None:
                 offset, length = rng
-            data = fs.filer.read_file(path, fs.master, offset, length)
+            # Adopt the caller's remaining deadline budget (sent beside
+            # the trace header) so downstream volume reads and their
+            # retries never outlive the caller's patience.
+            with retry.deadline_scope(
+                    retry.deadline_from_headers(self.headers)):
+                data = fs.filer.read_file(path, fs.master, offset,
+                                          length)
             ctype = entry.attr.mime or "application/octet-stream"
             self.send_response(206 if rng is not None else 200)
             if rng is not None:
@@ -471,17 +484,19 @@ def _make_http_handler(fs: FilerServer):
                     self._err(400, f"bad ttl {ttl!r}")
                     return
             try:
-                entry = fs.filer.write_file(
-                    path, body, fs.master,
-                    collection=col,
-                    replication=rep,
-                    ttl=ttl,
-                    mime=ctype if not ctype.startswith(
-                        "multipart/") else "",
-                    chunk_size=int(q["maxMB"]) * 1024 * 1024
-                    if "maxMB" in q else None,
-                    append=q.get("op") == "append",
-                    signatures=_parse_signatures(q))
+                with retry.deadline_scope(
+                        retry.deadline_from_headers(self.headers)):
+                    entry = fs.filer.write_file(
+                        path, body, fs.master,
+                        collection=col,
+                        replication=rep,
+                        ttl=ttl,
+                        mime=ctype if not ctype.startswith(
+                            "multipart/") else "",
+                        chunk_size=int(q["maxMB"]) * 1024 * 1024
+                        if "maxMB" in q else None,
+                        append=q.get("op") == "append",
+                        signatures=_parse_signatures(q))
             except FilerError as e:
                 self._err(409, str(e))
                 return
@@ -582,6 +597,8 @@ def main(argv: list[str]) -> int:
     conf = config_mod.load(args.config) if args.config else {}
     tls_mod.install_from_config(conf)
     tracing.configure_from(conf)
+    retry.configure_from(conf)
+    faults_mod.configure_from(conf)
     store = SqliteStore(args.db) if args.db else MemoryStore()
     filer = Filer(store)
     server = FilerServer(filer, ip=args.ip, port=args.port,

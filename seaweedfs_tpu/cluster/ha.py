@@ -34,11 +34,10 @@ import os
 import random
 import threading
 import time
-import urllib.request
 from pathlib import Path
 from typing import Callable, Optional
 
-from ..util import glog
+from ..util import glog, retry
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -316,12 +315,18 @@ class RaftNode:
     # ------------- transport -------------
 
     def _post(self, peer: str, path: str, payload: dict) -> Optional[dict]:
+        # Raft owns its own timing: election timeouts ARE the retry
+        # loop, so exactly one attempt, no breaker — a retry layer here
+        # would stretch heartbeat intervals and destabilize elections.
         try:
             body = json.dumps(payload).encode()
-            r = urllib.request.Request(
-                f"http://{peer}{path}", data=body, method="POST",
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(r, timeout=self.rpc_timeout) as f:
-                return json.loads(f.read() or b"{}")
+            with retry.deadline_scope(self.rpc_timeout):
+                f = retry.http_request(
+                    f"http://{peer}{path}", data=body, method="POST",
+                    headers={"Content-Type": "application/json"},
+                    point="master.rpc", timeout=self.rpc_timeout,
+                    retry_policy=retry.RetryPolicy(max_attempts=1),
+                    use_breaker=False)
+            return json.loads(f.data or b"{}")
         except Exception:  # noqa: BLE001 — unreachable peer = no vote
             return None

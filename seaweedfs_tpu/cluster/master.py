@@ -18,7 +18,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-import urllib.request
+import urllib.error
 from concurrent import futures
 from pathlib import Path
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -30,7 +30,9 @@ from ..pb import master_pb2, volume_server_pb2
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..util import config as config_mod
+from ..util import faults as faults_mod
 from ..util import glog
+from ..util import retry
 from ..util import security
 from ..util import tls as tls_mod
 from ..util import tracing
@@ -621,12 +623,20 @@ def _make_http_handler(ms: MasterServer):
                 if self.command == "POST":
                     n = int(self.headers.get("Content-Length", 0) or 0)
                     data = self.rfile.read(n) if n else b""
-                req = urllib.request.Request(
+                # No breaker: the "endpoint" is whoever holds the lease
+                # right now, and a 503 here is already the retry signal.
+                r = retry.http_request(
                     f"http://{leader}{self.path}", data=data,
-                    method=self.command)
-                with urllib.request.urlopen(req, timeout=10) as r:
-                    body = r.read()
+                    method=self.command, point="master.proxy",
+                    timeout=10, use_breaker=False)
                 self.send_response(r.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(r.data)))
+                self.end_headers()
+                self.wfile.write(r.data)
+            except urllib.error.HTTPError as e:
+                body = e.read()
+                self.send_response(e.code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -677,7 +687,8 @@ def _make_http_handler(ms: MasterServer):
                                 "Topology": ms.topology.to_map()})
                 elif u.path == "/metrics":
                     body = (ms.metrics.render()
-                            + tracing.METRICS.render()).encode()
+                            + tracing.METRICS.render()
+                            + retry.METRICS.render()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      EXPOSITION_CONTENT_TYPE)
@@ -780,6 +791,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
     tls_mod.install_from_config(conf)
     tracing.configure_from(conf)
+    retry.configure_from(conf)
+    faults_mod.configure_from(conf)
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,
                       default_replication=args.defaultReplication,

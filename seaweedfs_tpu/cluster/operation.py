@@ -6,17 +6,25 @@ call stack): ``assign`` asks the master for a file id + target server,
 both for a batch of files, ``lookup``/``download`` resolve and fetch,
 ``delete`` removes everywhere. These are what the CLI upload/download
 commands, the filer, and the benchmark harness use.
+
+Every HTTP call rides :func:`seaweedfs_tpu.util.retry.http_request`
+(config-driven deadline budgets, jittered retries, per-endpoint circuit
+breakers, fault points). ``download`` is the head of the graceful
+read-degradation ladder: first replica -> remaining replicas -> any
+server holding EC shards of the volume (whose EC read path
+reconstructs the needle), with each fallback hop traced and counted in
+``seaweed_degraded_reads_total``.
 """
 
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
-import urllib.request
 from dataclasses import dataclass
 from typing import Optional
 
-from ..util import tracing
+from ..util import faults, retry, tracing
 from .wdclient import MasterClient
 
 
@@ -43,22 +51,24 @@ def assign(master: MasterClient, count: int = 1, collection: str = "",
     has not heard from the volume servers yet; the node re-registers on
     its next pulse. A brief bounded retry (``retry_s``) absorbs that
     window instead of failing the caller's write; persistent
-    no-capacity still surfaces as the original error."""
-    import time as time_mod
-
-    deadline = time_mod.monotonic() + retry_s
+    no-capacity still surfaces as the original error. Injected
+    ``master.assign`` faults classify as transient too, so chaos runs
+    exercise the same path."""
+    deadline = retry.Deadline(retry_s)
     wait = 0.1
     while True:
         try:
+            faults.check("master.assign")
             r = master.assign(count=count, collection=collection,
                               replication=replication, ttl=ttl)
             break
-        except RuntimeError as e:
-            transient = ("no data node" in str(e)
+        except (RuntimeError, faults.FaultError) as e:
+            transient = (isinstance(e, faults.FaultError)
+                         or "no data node" in str(e)
                          or "free slots" in str(e))
-            if not transient or time_mod.monotonic() >= deadline:
+            if not transient or deadline.expired():
                 raise
-            time_mod.sleep(wait)
+            time.sleep(min(wait, max(0.0, deadline.remaining())))
             wait = min(wait * 2, 0.5)
     return AssignResult(fid=r["fid"], url=r["url"],
                         public_url=r["publicUrl"] or r["url"],
@@ -70,57 +80,99 @@ def upload(server_url: str, fid: str, data: bytes, jwt: str = "",
     url = f"http://{server_url}/{fid}"
     if collection:
         url += f"?collection={collection}"
-    req = urllib.request.Request(
-        url, data=data, method="POST", headers=tracing.inject({}))
-    if jwt:
-        req.add_header("Authorization", f"BEARER {jwt}")
     try:
         with tracing.span("volume.write", fid=fid) as sp:
             sp.n_bytes = len(data)
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return json.loads(resp.read() or b"{}")
+            resp = retry.http_request(url, data=data, method="POST",
+                                      point="volume.write", jwt=jwt)
+            return json.loads(resp.data or b"{}")
     except urllib.error.HTTPError as e:
         raise OperationError(
             f"upload to {url} failed: {e.code} {e.read()!r}") from e
 
 
+def _fid_url(server_url: str, fid: str, collection: str) -> str:
+    url = f"http://{server_url}/{fid}"
+    if collection:
+        url += f"?collection={collection}"
+    return url
+
+
 def download(master: MasterClient, fid: str,
              collection: str = "") -> bytes:
+    """Fetch one needle, degrading gracefully: every replica location
+    in turn, then — when all replicas are dead — any server holding EC
+    shards of the volume (its EC read path reassembles the needle from
+    surviving shards). Hops past the first choice are degraded reads:
+    traced and counted, never surfaced to the caller unless the whole
+    ladder is exhausted."""
     vid = int(fid.split(",")[0])
-    locs = master.lookup(vid, collection)
-    if not locs:
-        raise OperationError(f"volume {vid} has no locations")
+    try:
+        locs = master.lookup(vid, collection)
+    except (KeyError, RuntimeError):
+        locs = []  # volume may still live on as EC shards
     last: Optional[Exception] = None
-    for loc in locs:
-        url = f"http://{loc['url']}/{fid}"
-        if collection:
-            url += f"?collection={collection}"
-        req = urllib.request.Request(url, headers=tracing.inject({}))
-        try:
-            with tracing.span("volume.read", fid=fid) as sp:
-                with urllib.request.urlopen(req, timeout=60) as resp:
-                    data = resp.read()
-                sp.n_bytes = len(data)
-                return data
-        except urllib.error.URLError as e:
-            last = e
+    with tracing.span("volume.read", fid=fid) as sp:
+        for i, loc in enumerate(locs):
+            url = _fid_url(loc["url"], fid, collection)
+            try:
+                if i:
+                    retry.record_degraded("replica_failover")
+                    with tracing.span("read.degraded", fid=fid,
+                                      stage="replica_failover",
+                                      server=loc["url"]):
+                        resp = retry.http_request(url,
+                                                  point="volume.read")
+                else:
+                    resp = retry.http_request(url, point="volume.read")
+                sp.n_bytes = len(resp.data)
+                return resp.data
+            except urllib.error.URLError as e:
+                last = e
+        if locs:
+            # every advertised location failed: the map is stale
+            master.invalidate(vid)
+        # EC rung: a sealed volume's replicas are gone by design; any
+        # server holding shards can reconstruct the needle server-side.
+        for server in _ec_servers(master, vid):
+            try:
+                retry.record_degraded("ec_decode")
+                with tracing.span("read.degraded", fid=fid,
+                                  stage="ec_decode", server=server):
+                    resp = retry.http_request(
+                        _fid_url(server, fid, collection),
+                        point="volume.read")
+                sp.n_bytes = len(resp.data)
+                return resp.data
+            except urllib.error.URLError as e:
+                last = e
+    if last is None:
+        raise OperationError(f"volume {vid} has no locations")
     raise OperationError(f"download {fid} failed: {last}")
+
+
+def _ec_servers(master: MasterClient, vid: int) -> list[str]:
+    """Servers holding EC shards of ``vid``, deduped, shard-majority
+    holders first (fewer remote interval reads for the reconstructor)."""
+    try:
+        shard_locs = master.lookup_ec(vid)
+    except Exception:  # noqa: BLE001 — no EC shards: ladder exhausted
+        return []
+    counts: dict[str, int] = {}
+    for urls in shard_locs.values():
+        for u in urls:
+            counts[u] = counts.get(u, 0) + 1
+    return sorted(counts, key=counts.get, reverse=True)
 
 
 def delete(master: MasterClient, fid: str, jwt: str = "",
            collection: str = "") -> None:
     vid = int(fid.split(",")[0])
     for loc in master.lookup(vid, collection):
-        url = f"http://{loc['url']}/{fid}"
-        if collection:
-            url += f"?collection={collection}"
-        req = urllib.request.Request(
-            url, method="DELETE", headers=tracing.inject({}))
-        if jwt:
-            req.add_header("Authorization", f"BEARER {jwt}")
+        url = _fid_url(loc["url"], fid, collection)
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                resp.read()
+            retry.http_request(url, method="DELETE",
+                               point="volume.delete", jwt=jwt)
             return  # the server fans the delete out to replicas
         except urllib.error.URLError:
             continue

@@ -38,7 +38,7 @@ from ..storage.store import Store, StoreError
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
-from ..util import glog, security, tracing, varz
+from ..util import faults, glog, retry, security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import telemetry as telemetry_mod
 from .master import _grpc_port
@@ -411,12 +411,13 @@ class VolumeServer:
     def read_bytes(self, volume_id: int, fid: FileId,
                    collection: str = "") -> bytes:
         """GET path: normal volume first, then mounted EC shards."""
+        faults.check("volume.read")
         if self.store.has_volume(volume_id, collection):
             with tracing.span("store.read_needle", vid=volume_id) as sp:
                 n = self.store.read_needle(volume_id, fid.key,
                                            fid.cookie, collection)
                 sp.n_bytes = len(n.data)
-            return n.data
+            return faults.mangle("volume.read", n.data)
         ckey = self._ec_cache_key(volume_id, fid)
         cached = self.chunk_cache.get(ckey)
         if cached is not None:
@@ -963,7 +964,8 @@ def _make_http_handler(vs: VolumeServer):
                 return
             if u.path == "/metrics":
                 self._send(200, (vs.metrics.render()
-                                 + tracing.METRICS.render()).encode(),
+                                 + tracing.METRICS.render()
+                                 + retry.METRICS.render()).encode(),
                            EXPOSITION_CONTENT_TYPE)
                 return
             if u.path == "/debug/traces":
@@ -1100,20 +1102,15 @@ def _make_http_handler(vs: VolumeServer):
 def _replicate_http(peer_url: str, fid: str, body: Optional[bytes],
                     jwt: str = "", collection: str = "") -> None:
     """Fan a write/delete out to one replica (?type=replicate stops the
-    fan-out from cascading; topology/store_replicate.go)."""
-    import urllib.request
-
+    fan-out from cascading; topology/store_replicate.go). Rides the
+    resilience layer: a replica mid-restart gets jittered retries, a
+    dead one trips its breaker instead of stalling every write."""
     url = f"http://{peer_url}/{fid}?type=replicate"
     if collection:
         url += f"&collection={collection}"
-    if body is None:
-        req = urllib.request.Request(url, method="DELETE")
-    else:
-        req = urllib.request.Request(url, data=body, method="POST")
-    if jwt:
-        req.add_header("Authorization", f"BEARER {jwt}")
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        resp.read()
+    retry.http_request(url, data=body,
+                       method="DELETE" if body is None else "POST",
+                       point="replica.push", jwt=jwt, timeout=30)
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -1147,6 +1144,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     tls_mod.install_from_config(conf)
     tracing.configure_from(conf)
     telemetry_mod.configure_from(conf)
+    retry.configure_from(conf)
+    faults.configure_from(conf)
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,
                   needle_map=args.index)
     store.load_existing()

@@ -16,6 +16,7 @@ from .. import pb
 from ..cache import METRICS as _cache_metrics
 from ..pb import master_pb2
 from .master import _grpc_port
+from ..util import retry
 from ..util import tls as tls_mod
 from ..util import tracing
 
@@ -66,16 +67,24 @@ class MasterClient:
     def _with_failover(self, call):
         """Run ``call()``; on a not-leader error follow the named
         leader (or rotate and wait briefly when the leader is unknown
-        mid-election), on a dead connection rotate masters; retries are
-        bounded by the master count."""
+        mid-election), on a dead connection rotate masters. Dial
+        failures and named-leader follows are bounded by the master
+        count; the wait-out-an-election loop is bounded by the request
+        deadline (ambient, or the policy's failover budget) — it must
+        never spin forever when no leader emerges."""
         import grpc
 
+        budget = retry.current_deadline() or retry.Deadline(
+            retry.policy().failover_budget)
         last: Exception = RuntimeError("no master configured")
-        for _ in range(max(3, len(self.master_urls) + 1)):
+        attempts = 0
+        max_attempts = max(3, len(self.master_urls) + 1)
+        while attempts < max_attempts:
             try:
                 return call()
             except grpc.RpcError as e:
                 last = e
+                attempts += 1
                 self._rotate()
             except RuntimeError as e:
                 msg = str(e)
@@ -84,12 +93,17 @@ class MasterClient:
                 last = e
                 m = _LEADER_RE.search(msg)
                 if m:
+                    attempts += 1
                     self._redial(m.group(1))
                 else:
-                    # election in flight: try the next master after a
-                    # beat (elections settle in well under a second)
+                    # Election in flight: rotate and wait a beat
+                    # (elections settle in well under a second). This
+                    # rung retries on TIME, not attempts — but only
+                    # while the request deadline has budget left.
+                    if budget.expired():
+                        raise last
                     self._rotate()
-                    time.sleep(0.3)
+                    time.sleep(min(0.3, max(0.0, budget.remaining())))
         raise last
 
     def close(self) -> None:

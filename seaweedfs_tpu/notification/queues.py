@@ -4,11 +4,10 @@ from __future__ import annotations
 
 import json
 import threading
-import urllib.request
 from pathlib import Path
 from typing import Optional
 
-from ..util import glog
+from ..util import glog, retry
 
 
 def event_to_dict(ev) -> dict:
@@ -76,12 +75,16 @@ class HttpWebhookQueue(MessageQueue):
 
     def send(self, event: dict) -> None:
         body = json.dumps(event).encode()
-        req = urllib.request.Request(
-            self.url, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout):
-                self.sent += 1
+            # Single attempt (best-effort delivery must not stall the
+            # bridge) but breaker-guarded: a dead endpoint fails fast
+            # instead of eating a connect timeout per event.
+            retry.http_request(
+                self.url, data=body, method="POST",
+                headers={"Content-Type": "application/json"},
+                point="notify.webhook", timeout=self.timeout,
+                retry_policy=retry.RetryPolicy(max_attempts=1))
+            self.sent += 1
         except Exception as e:  # noqa: BLE001 — drop, don't stall
             self.dropped += 1
             if self.dropped in (1, 10, 100) or self.dropped % 1000 == 0:

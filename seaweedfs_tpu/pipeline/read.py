@@ -18,6 +18,7 @@ import numpy as np
 
 from ..ops.rs_ref import TooFewShardsError
 from ..storage import ec_files, idx as idx_mod, needle as needle_mod
+from ..util import faults, retry
 from .scheme import DEFAULT_SCHEME, EcScheme
 
 
@@ -59,15 +60,23 @@ class EcVolumeReader:
 
     def _read_shard_range(self, shard_id: int, offset: int, size: int
                           ) -> Optional[np.ndarray]:
+        """One interval from one shard file; ``None`` means "this shard
+        can't serve it" — absent file, injected fault, or a short read
+        (shard mid-copy / truncated). A damaged shard degrades into the
+        reconstruction path instead of failing the whole needle read."""
+        try:
+            faults.check("ec.shard_read")
+        except faults.FaultError:
+            return None
         p = ec_files.shard_path(self.base, shard_id)
         if not p.exists():
             return None
         with open(p, "rb") as f:
             f.seek(offset)
             buf = f.read(size)
+        buf = faults.mangle("ec.shard_read", buf)
         if len(buf) != size:
-            raise EcReadError(
-                f"short read from {p}: wanted {size} at {offset}")
+            return None
         return np.frombuffer(buf, dtype=np.uint8)
 
     def _recover_interval(self, shard_id: int, offset: int, size: int
@@ -96,6 +105,7 @@ class EcVolumeReader:
             out = np.asarray(self.scheme.encoder.reconstruct_batch_host(
                 chunk, present, [shard_id]))[0, 0]
         self.intervals_repaired += 1
+        retry.record_degraded("ec_reconstruct")
         return out
 
     # -- needle reads -----------------------------------------------------

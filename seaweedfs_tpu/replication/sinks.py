@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..cluster.filer_client import FilerClient, FilerClientError
-from ..util import glog
+from ..util import glog, retry
 
 
 def _as_filer_client(c: "FilerClient | str") -> FilerClient:
@@ -163,7 +163,6 @@ class S3Sink(ReplicationSink):
     def _request(self, method: str, path: str, body: bytes = b"",
                  mime: str = "") -> None:
         import urllib.error
-        import urllib.request
 
         url = self._url(path)
         headers = {"Content-Type": mime} if mime else {}
@@ -173,12 +172,11 @@ class S3Sink(ReplicationSink):
                                            self.access_key,
                                            self.secret_key,
                                            region=self.region)
-        req = urllib.request.Request(
-            url, data=body if method == "PUT" else None,
-            method=method, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=60):
-                pass
+            retry.http_request(url,
+                               data=body if method == "PUT" else None,
+                               method=method, headers=headers,
+                               point="sink.s3")
         except urllib.error.HTTPError as e:
             if method == "DELETE" and e.code == 404:
                 return

@@ -149,14 +149,15 @@ class ClusterEnv:
         connection failure -> ShellError naming the master)."""
         import json as json_mod
         import urllib.error
-        import urllib.request
+
+        from ..util import retry
 
         host = host or self.master_url
-        req = urllib.request.Request(f"http://{host}{path_q}",
-                                     method=method)
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                return json_mod.loads(resp.read() or b"{}")
+            resp = retry.http_request(f"http://{host}{path_q}",
+                                      method=method,
+                                      point="master.rpc", timeout=30)
+            return json_mod.loads(resp.data or b"{}")
         except urllib.error.HTTPError as e:
             try:
                 msg = json_mod.loads(e.read()).get("error", str(e))
@@ -297,26 +298,21 @@ def _http_delete_needle(env: "ClusterEnv", url: str, vid: int,
     check.disk -resolveDeletes so the auth/URL shape lives once.
     Raises on failure — note the contacted server may have applied
     the tombstone even when its replica fan-out then failed."""
-    import urllib.request
-
     from ..pb import volume_server_pb2 as vpb
     from ..storage import needle as needle_mod
     from ..storage.types import FileId
-    from ..util import security
+    from ..util import retry, security
 
     blob = env.volume(url).ReadNeedleBlob(
         vpb.ReadNeedleBlobRequest(volume_id=vid, collection=col,
                                   needle_id=key))
     cookie = needle_mod.parse_header(blob.needle_blob)[0]
     fid = str(FileId(volume_id=vid, key=key, cookie=cookie))
-    req = urllib.request.Request(
-        f"http://{url}/{fid}" + (f"?collection={col}" if col else ""),
-        method="DELETE")
     guard = security.Guard(env.secret)
-    if guard.enabled:
-        req.add_header("Authorization", f"BEARER {guard.sign(fid)}")
-    with urllib.request.urlopen(req, timeout=60):
-        pass
+    retry.http_request(
+        f"http://{url}/{fid}" + (f"?collection={col}" if col else ""),
+        method="DELETE", point="volume.delete",
+        jwt=guard.sign(fid) if guard.enabled else "", timeout=60)
 
 
 CLUSTER_COMMANDS: dict[str, Callable[[ClusterEnv, list[str]], None]] = {}
@@ -940,7 +936,8 @@ def cmd_volume_fix_replication(env: ClusterEnv, argv: list[str]) -> None:
 def cmd_volume_grow(env: ClusterEnv, argv: list[str]) -> None:
     """Pre-grow writable volumes via the master (/vol/grow)."""
     import json
-    import urllib.request
+
+    from ..util import retry
 
     p = _parser("volume.grow")
     p.add_argument("-count", type=int, default=1)
@@ -950,9 +947,9 @@ def cmd_volume_grow(env: ClusterEnv, argv: list[str]) -> None:
     url = (f"http://{env.master_url}/vol/grow?count={args.count}"
            f"&collection={args.collection}"
            f"&replication={args.replication}")
-    req = urllib.request.Request(url, method="POST")
-    with urllib.request.urlopen(req, timeout=60) as resp:
-        doc = json.loads(resp.read())
+    resp = retry.http_request(url, method="POST", point="master.rpc",
+                              timeout=60)
+    doc = json.loads(resp.data)
     if "error" in doc:
         raise ShellError(doc["error"])
     env.println(f"volume.grow: created volumes {doc['volumeIds']}")
@@ -1496,7 +1493,8 @@ def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
                     env.println(f"  orphan needle {k}")
             if args.purge and key_ not in is_ec:
                 import time as time_mod
-                import urllib.request
+
+                from ..util import retry
                 url = vol_holder[key_]
                 now_ns = time_mod.time_ns()
                 for k in sorted(extra):
@@ -1529,17 +1527,13 @@ def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
                     cookie = rec.cookie
                     fid = str(FileId(volume_id=vid, key=k,
                                      cookie=cookie))
-                    req = urllib.request.Request(
-                        f"http://{url}/{fid}"
-                        + (f"?collection={col}" if col else ""),
-                        method="DELETE")
                     try:
-                        if guard.enabled:
-                            req.add_header(
-                                "Authorization",
-                                f"BEARER {guard.sign(fid)}")
-                        with urllib.request.urlopen(req, timeout=60):
-                            pass
+                        retry.http_request(
+                            f"http://{url}/{fid}"
+                            + (f"?collection={col}" if col else ""),
+                            method="DELETE", point="volume.delete",
+                            jwt=(guard.sign(fid) if guard.enabled
+                                 else ""), timeout=60)
                         purged += 1
                     except Exception as e:  # noqa: BLE001
                         # one vanished/failed needle (vacuum racing

@@ -448,6 +448,62 @@ def cmd_trace_dump(env: CommandEnv, argv: list[str]) -> None:
         env.println(tracing.render_trace(t))
 
 
+@command("fault.inject")
+def cmd_fault_inject(env: CommandEnv, argv: list[str]) -> None:
+    """Arm a fault at a named point (docs/robustness.md):
+    fault.inject -point volume.read -spec error@0.5#10"""
+    p = _parser("fault.inject")
+    p.add_argument("-point", required=True,
+                   help="fault point name (see fault.list)")
+    p.add_argument("-spec", required=True,
+                   help="action[@probability][:param][#count]")
+    p.add_argument("-seed", type=int, default=None,
+                   help="override the deterministic replay seed")
+    args = p.parse_args(argv)
+    from ..util import faults
+    try:
+        fs = faults.inject(args.point, args.spec, seed=args.seed)
+    except faults.FaultSpecError as e:
+        raise ShellError(f"fault.inject: {e}") from None
+    env.println(f"fault.inject: armed {fs.point}={fs.spec}")
+
+
+@command("fault.list")
+def cmd_fault_list(env: CommandEnv, argv: list[str]) -> None:
+    """Armed fault specs (with hit counts) and the point catalog."""
+    p = _parser("fault.list")
+    p.parse_args(argv)
+    from ..util import faults
+    payload = faults.debug_payload()
+    env.println(f"fault.list: enabled={payload['enabled']} "
+                f"seed={payload['seed']} "
+                f"armed={len(payload['specs'])}")
+    for s in payload["specs"]:
+        left = "unbounded" if s["remaining"] < 0 else s["remaining"]
+        env.println(f"  {s['point']}={s['spec']} hits={s['hits']} "
+                    f"remaining={left}")
+    env.println("  points: " + ", ".join(faults.CATALOG))
+
+
+@command("fault.clear")
+def cmd_fault_clear(env: CommandEnv, argv: list[str]) -> None:
+    """Disarm one fault point (or all), optionally also forgetting
+    circuit-breaker state accumulated while faults were armed."""
+    p = _parser("fault.clear")
+    p.add_argument("-point", default="",
+                   help="one point to disarm (default: all)")
+    p.add_argument("-breakers", action="store_true",
+                   help="also reset all circuit breakers")
+    args = p.parse_args(argv)
+    from ..util import faults, retry
+    faults.clear(args.point or None)
+    if args.breakers:
+        retry.reset_breakers()
+    env.println("fault.clear: "
+                + (args.point or "all points") + " disarmed"
+                + (" + breakers reset" if args.breakers else ""))
+
+
 def run_command(env: CommandEnv, line: str) -> None:
     """Parse and run one shell line."""
     parts = shlex.split(line)

@@ -30,6 +30,8 @@ from dataclasses import asdict, dataclass
 from pathlib import Path
 from typing import Optional
 
+from ..util import faults, retry
+
 TIER_SUFFIX = ".tier"
 BLOCK = 1024 * 1024
 MAX_CACHED_BLOCKS = 64
@@ -145,12 +147,10 @@ class S3TierFile:
         """One ranged GET of [start, end) from the object store."""
         url = _object_url(self.info)
         headers = {"Range": f"bytes={start}-{end - 1}"}
-        req = urllib.request.Request(
-            url, headers=_signed(self.info, "GET", url, headers),
-            method="GET")
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
-                return r.read()
+            return retry.http_request(
+                url, headers=_signed(self.info, "GET", url, headers),
+                point="tier.copy").data
         except urllib.error.HTTPError as e:
             raise TierError(
                 f"s3 tier read {url} [{start}:{end}): "
@@ -242,11 +242,9 @@ def upload_volume_dat(base: str | Path, endpoint: str, bucket: str,
     url = _object_url(info)
     body = dat.read_bytes() if size <= chunk else None
     if body is not None:
-        req = urllib.request.Request(
-            url, data=body, method="PUT",
-            headers=_signed(info, "PUT", url, {}, body))
-        with urllib.request.urlopen(req, timeout=300):
-            pass
+        retry.http_request(url, data=body, method="PUT",
+                           headers=_signed(info, "PUT", url, {}, body),
+                           point="tier.copy", timeout=300)
     else:
         # stream from disk: urllib sends file-like bodies chunked; the
         # signature (when auth is on) must then be computed over the
@@ -255,6 +253,9 @@ def upload_volume_dat(base: str | Path, endpoint: str, bucket: str,
         if info.access_key:
             _multipart_upload(info, dat, chunk)
         else:
+            # file-like body: can't buffer through http_request (it
+            # would defeat the streaming); fault point only
+            faults.check("tier.copy")
             with open(dat, "rb") as f:
                 req = urllib.request.Request(
                     url, data=f, method="PUT",
@@ -272,11 +273,11 @@ def _multipart_upload(info: TierInfo, dat: Path, chunk: int) -> None:
     import re
 
     base_url = _object_url(info)
-    req = urllib.request.Request(
+    r = retry.http_request(
         base_url + "?uploads", method="POST",
-        headers=_signed(info, "POST", base_url + "?uploads", {}))
-    with urllib.request.urlopen(req, timeout=60) as r:
-        m = re.search(rb"<UploadId>([^<]+)</UploadId>", r.read())
+        headers=_signed(info, "POST", base_url + "?uploads", {}),
+        point="tier.copy", timeout=60)
+    m = re.search(rb"<UploadId>([^<]+)</UploadId>", r.data)
     if not m:
         raise TierError("multipart initiate returned no UploadId")
     upload_id = m.group(1).decode()
@@ -287,18 +288,15 @@ def _multipart_upload(info: TierInfo, dat: Path, chunk: int) -> None:
             if not piece:
                 break
             url = f"{base_url}?partNumber={part}&uploadId={upload_id}"
-            req = urllib.request.Request(
+            retry.http_request(
                 url, data=piece, method="PUT",
-                headers=_signed(info, "PUT", url, {}, piece))
-            with urllib.request.urlopen(req, timeout=600):
-                pass
+                headers=_signed(info, "PUT", url, {}, piece),
+                point="tier.copy", timeout=600)
             part += 1
     url = f"{base_url}?uploadId={upload_id}"
-    req = urllib.request.Request(
-        url, data=b"", method="POST",
-        headers=_signed(info, "POST", url, {}))
-    with urllib.request.urlopen(req, timeout=600):
-        pass
+    retry.http_request(url, data=b"", method="POST",
+                       headers=_signed(info, "POST", url, {}),
+                       point="tier.copy", timeout=600)
 
 
 def download_volume_dat(base: str | Path,
@@ -314,6 +312,9 @@ def download_volume_dat(base: str | Path,
     dat = Path(base + ".dat")
     part = Path(base + ".dat.part")
     url = _object_url(info)
+    # streamed to disk chunk-by-chunk: fault point only (buffering the
+    # whole object through http_request would defeat the streaming)
+    faults.check("tier.copy")
     req = urllib.request.Request(
         url, headers=_signed(info, "GET", url, {}), method="GET")
     with urllib.request.urlopen(req, timeout=3600) as r, \

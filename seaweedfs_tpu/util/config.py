@@ -70,6 +70,30 @@ slow_threshold_seconds = 1.0     # slower roots log a span-tree line
 [telemetry]
 enabled = true                   # false makes the collector a no-op
 """,
+    "retry": """\
+# retry.toml — unified resilience policy (docs/robustness.md).
+[retry]
+max_attempts = 4                 # per request, first try included
+base_delay_seconds = 0.05        # full-jitter exponential backoff base
+max_delay_seconds = 2.0          # backoff cap
+request_timeout_seconds = 60.0   # default per-request deadline budget
+failover_budget_seconds = 5.0    # cap on waiting out a master election
+
+[retry.breaker]
+failure_threshold = 5            # consecutive failures -> open
+cooldown_seconds = 5.0           # open -> half-open probe delay
+""",
+    "faults": """\
+# faults.toml — deterministic fault injection (docs/robustness.md).
+# Spec syntax: action[@probability][:param][#count], e.g.
+#   "volume.read=error@0.5#10"   first 10 coin-flip wins raise
+#   "filer.data=delay:0.2"       200 ms latency on every call
+#   "ec.shard_read=truncate:0.5" shard reads return half the bytes
+[faults]
+enabled = false                  # master switch (SEAWEED_FAULTS too)
+seed = 0                         # deterministic replay seed
+inject = ""                      # "point=spec;point=spec;..."
+""",
 }
 
 

@@ -46,6 +46,7 @@ def _open_fds() -> Optional[int]:
 
 def payload(component: str, metrics: Optional[Metrics] = None,
             extra: Optional[dict] = None) -> dict:
+    from . import faults, retry  # here, not top: retry imports varz users
     out = {
         "component": component,
         "pid": os.getpid(),
@@ -56,6 +57,8 @@ def payload(component: str, metrics: Optional[Metrics] = None,
         "threads": threading.active_count(),
         "gc_counts": gc.get_count(),
         "slow_requests": tracing.slow_requests(),
+        "breakers": retry.breakers_payload(),
+        "faults": faults.debug_payload(),
     }
     rss = _rss_bytes()
     if rss is not None:

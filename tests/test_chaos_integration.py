@@ -1,0 +1,226 @@
+"""Chaos harness: a real localhost mini-cluster under injected faults.
+
+The robustness acceptance bar (docs/robustness.md): wherever redundancy
+exists — a second replica, or >= k surviving EC shards — injected
+failures must produce ZERO client-visible errors, only degraded reads
+counted in ``seaweed_degraded_reads_total``. Three scenarios:
+
+1. replica death: replication=010, one holder killed between write and
+   read — reads fail over to the surviving replica;
+2. transient-error + latency storm on the volume read path, injected
+   through the ``volume.read`` fault point — absorbed by retries;
+3. truncated EC shard reads on a sealed volume, injected through
+   ``ec.shard_read`` — absorbed by interval reconstruction.
+
+Everything runs in one process, so the injected faults, the retry
+metrics, and the degraded-read counters are all directly observable.
+"""
+
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cluster import operation
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.cluster.wdclient import MasterClient
+from seaweedfs_tpu.pb import volume_server_pb2
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.util import faults, retry
+
+PULSE = 0.2
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """Faults disarmed, breakers forgotten, fast backoff — and all of
+    it restored afterwards, so chaos never leaks into other tests."""
+    saved = {k: getattr(retry.policy(), k)
+             for k in ("base_delay", "max_delay", "breaker_cooldown")}
+    retry.configure(base_delay=0.01, max_delay=0.1,
+                    breaker_cooldown=0.5)
+    faults.clear()
+    retry.reset_breakers()
+    yield
+    faults.clear()
+    retry.reset_breakers()
+    retry.configure(**saved)
+
+
+def _mini_cluster(tmp_path_factory, n):
+    master = MasterServer(port=_free_port_pair(),
+                          volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=42).start()
+    servers = []
+    for i in range(n):
+        d = tmp_path_factory.mktemp(f"chaos{i}")
+        servers.append(VolumeServer(
+            Store([d], max_volumes=8), port=_free_port_pair(),
+            master_url=master.url, data_center="dc1", rack=f"r{i % 2}",
+            pulse_seconds=PULSE).start())
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < n:
+        time.sleep(0.05)
+    assert len(master.topology.nodes) == n, "volume servers never joined"
+    return master, servers
+
+
+def _teardown(master, servers):
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001 — some are killed mid-test
+            pass
+    master.stop()
+
+
+def _degraded(stage):
+    return retry.METRICS.counter("degraded_reads_total",
+                                 stage=stage).value
+
+
+def test_replica_death_is_invisible_to_readers(tmp_path_factory):
+    master, servers = _mini_cluster(tmp_path_factory, 3)
+    mc = MasterClient(master.url)
+    try:
+        a = operation.assign(mc, collection="chaos", replication="010")
+        want = b"survives-replica-death" * 40
+        operation.upload(a.url, a.fid, want, jwt=a.auth,
+                         collection="chaos")
+        vid = int(a.fid.split(",")[0])
+        time.sleep(2.5 * PULSE)  # let the replica land + heartbeat
+
+        # Warm the location cache, then kill the FIRST advertised
+        # location — the one every read tries first.
+        locs = mc.lookup(vid, "chaos")
+        assert len(locs) == 2, locs
+        victim = next(vs for vs in servers
+                      if vs.url == locs[0]["url"])
+        victim.stop()
+
+        before = _degraded("replica_failover")
+        for _ in range(3):
+            assert operation.download(mc, a.fid,
+                                      collection="chaos") == want
+        assert _degraded("replica_failover") > before
+        # the dead endpoint's breaker saw every failed dial
+        assert any(b["endpoint"] == victim.url
+                   and b["consecutive_failures"] > 0
+                   for b in retry.breakers_payload())
+    finally:
+        mc.close()
+        _teardown(master, servers)
+
+
+def test_error_and_latency_storm_absorbed_by_retries(tmp_path_factory):
+    master, servers = _mini_cluster(tmp_path_factory, 1)
+    mc = MasterClient(master.url)
+    try:
+        payloads = [bytes([60 + i]) * 1500 for i in range(6)]
+        fids = operation.submit(mc, payloads)
+
+        # Error storm: the first 3 volume.read calls die (injected at
+        # the client-side fault point, so the retry loop absorbs them
+        # inside ONE download); budget-bounded so the outcome is
+        # deterministic, not a coin flip against max_attempts.
+        faults.inject("volume.read", "error#3")
+        for fid, want in zip(fids, payloads):
+            assert operation.download(mc, fid) == want
+        assert faults.specs()[0]["hits"] == 3
+        assert retry.METRICS.counter(
+            "retries_total", point="volume.read").value >= 3
+
+        # Latency storm: injected delays slow calls down but nothing
+        # fails, and the per-request deadline is nowhere near spent.
+        faults.inject("volume.read", "delay:0.05#4")
+        for fid, want in zip(fids, payloads):
+            assert operation.download(mc, fid) == want
+    finally:
+        mc.close()
+        _teardown(master, servers)
+
+
+def test_truncated_ec_shard_reads_reconstruct(tmp_path_factory):
+    import grpc
+
+    from seaweedfs_tpu import pb
+    from seaweedfs_tpu.cluster.master import _grpc_port
+
+    master, servers = _mini_cluster(tmp_path_factory, 1)
+    vs = servers[0]
+    mc = MasterClient(master.url)
+    ch = None
+    try:
+        import numpy as np
+        rng = np.random.default_rng(11)
+        blobs = [rng.integers(0, 256, 2000 + i,
+                              dtype=np.uint8).tobytes()
+                 for i in range(25)]
+        fids = operation.submit(mc, blobs)
+        by_vid = {}
+        for f, b in zip(fids, blobs):
+            by_vid.setdefault(int(f.split(",")[0]), []).append((f, b))
+        # the fullest volume: enough needles for a cached baseline set
+        # AND an uncached fault-phase set
+        vid, keep = max(by_vid.items(), key=lambda kv: len(kv[1]))
+        assert len(keep) >= 3, "need several needles on one volume"
+
+        # Seal: encode to 14 shards, mount them all, drop the .dat.
+        ch = grpc.insecure_channel(f"127.0.0.1:{_grpc_port(vs.port)}")
+        stub = pb.volume_stub(ch)
+        stub.VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+        stub.VolumeEcShardsGenerate(
+            volume_server_pb2.VolumeEcShardsGenerateRequest(
+                volume_id=vid))
+        stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, shard_ids=list(range(14))))
+        stub.VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid))
+        vs.heartbeat_now()
+        time.sleep(2.5 * PULSE)
+        mc.invalidate()
+
+        # Baseline EC reads (these land in the EC needle cache).
+        for fid, want in keep[:2]:
+            assert operation.download(mc, fid) == want
+
+        # Truncation storm on UNCACHED needles: the first interval read
+        # comes back short -> treated as shard-missing -> interval
+        # reconstruction from the surviving shards; the budget (#4)
+        # leaves exactly >= k=10 clean shards for the recovery read.
+        before = _degraded("ec_reconstruct")
+        faults.inject("ec.shard_read", "truncate:0.9#4")
+        for fid, want in keep[2:]:
+            assert operation.download(mc, fid) == want
+        assert _degraded("ec_reconstruct") > before
+        assert faults.specs()[0]["hits"] >= 1
+
+        # the degradation counter is on the wire for scrapers
+        with urllib.request.urlopen(
+                f"http://{vs.url}/metrics") as r:
+            assert b"seaweed_degraded_reads_total" in r.read()
+    finally:
+        if ch is not None:
+            ch.close()
+        mc.close()
+        _teardown(master, servers)

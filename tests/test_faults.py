@@ -1,0 +1,459 @@
+"""Fault-injection plane + resilience layer (docs/robustness.md).
+
+Unit coverage for the pieces the chaos tests exercise end-to-end:
+fault-spec parsing and deterministic replay, deadline budgets and their
+header propagation, retryable-error classification, full-jitter
+backoff bounds, :func:`retry.http_request` against a scripted HTTP
+server, the circuit-breaker state machine, the replica-push path under
+injected faults (ISSUE satellite), the wdclient election-wait deadline
+cap (ISSUE satellite), and the grep-style guarantee that no module in
+``cluster/``, ``replication/``, or ``gateway/`` bypasses the layer
+with a bare ``urllib.request.urlopen``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from seaweedfs_tpu.util import faults, retry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.clear()
+    faults.configure(enabled=True, seed=0)
+    retry.reset_breakers()
+    yield
+    faults.clear()
+    faults.configure(enabled=True, seed=0)
+    retry.reset_breakers()
+
+
+# -- fault specs -----------------------------------------------------------
+
+def test_spec_parses_all_fields():
+    fs = faults.FaultSpec("volume.read", "error@0.3#5")
+    assert fs.action == "error"
+    assert fs.probability == 0.3
+    assert fs.remaining == 5
+    fs = faults.FaultSpec("x", "delay:0.2")
+    assert fs.action == "delay" and fs.param == 0.2
+    fs = faults.FaultSpec("x", "truncate")
+    assert fs.param == 0.5  # default truncation fraction
+
+
+@pytest.mark.parametrize("bad", ["explode", "error@x", "delay:y",
+                                 "error#z", ""])
+def test_bad_spec_raises(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultSpec("p", bad)
+
+
+def test_fire_schedule_is_deterministic_per_seed():
+    a = faults.FaultSpec("p", "error@0.5", seed=7)
+    b = faults.FaultSpec("p", "error@0.5", seed=7)
+    c = faults.FaultSpec("p", "error@0.5", seed=8)
+    sched_a = [a.fire() for _ in range(64)]
+    sched_b = [b.fire() for _ in range(64)]
+    sched_c = [c.fire() for _ in range(64)]
+    assert sched_a == sched_b
+    assert sched_a != sched_c
+    assert 10 < sum(sched_a) < 54  # roughly fair coin
+
+
+def test_count_budget_caps_injections():
+    faults.inject("p", "error#2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            faults.check("p")
+    faults.check("p")  # budget spent: no-op forever after
+    assert faults.specs()[0]["hits"] == 2
+
+
+def test_check_actions():
+    faults.inject("p", "drop")
+    with pytest.raises(faults.FaultDrop):
+        faults.check("p")
+    faults.inject("p", "delay:0.05")
+    t0 = time.monotonic()
+    faults.check("p")
+    assert time.monotonic() - t0 >= 0.04
+    # data actions never fire in check(), only in mangle()
+    faults.inject("p", "truncate:0.5")
+    faults.check("p")
+    assert faults.mangle("p", b"x" * 100) == b"x" * 50
+    faults.inject("p", "corrupt")
+    mangled = faults.mangle("p", b"\x00" * 100)
+    assert mangled != b"\x00" * 100 and len(mangled) == 100
+
+
+def test_disabled_plane_is_inert():
+    faults.inject("p", "error")
+    faults.configure(enabled=False)
+    faults.check("p")
+    assert faults.mangle("p", b"abc") == b"abc"
+    assert not faults.active()
+
+
+def test_inject_all_and_env(monkeypatch):
+    faults.inject_all("a=error; b=delay:0.1@0.5#3")
+    points = {s["point"]: s for s in faults.specs()}
+    assert points["a"]["action"] == "error"
+    assert points["b"]["remaining"] == 3
+    with pytest.raises(faults.FaultSpecError):
+        faults.inject_all("garbage-without-equals")
+    faults.clear()
+    faults.configure_from_env({"SEAWEED_FAULTS": "c=drop",
+                               "SEAWEED_FAULTS_SEED": "9"})
+    assert faults.specs()[0]["point"] == "c"
+    assert faults.debug_payload()["seed"] == 9
+
+
+def test_configure_from_toml_block():
+    faults.configure_from({"faults": {"enabled": True, "seed": 3,
+                                      "inject": "x=error#1"}})
+    assert faults.debug_payload()["seed"] == 3
+    assert faults.specs()[0]["spec"] == "error#1"
+    retry.configure_from({"retry": {"max_attempts": 4}})  # no-op path
+
+
+# -- deadlines -------------------------------------------------------------
+
+def test_deadline_budget_and_header_roundtrip():
+    dl = retry.Deadline(5.0)
+    assert 4.0 < dl.remaining() <= 5.0
+    assert not dl.expired()
+    with retry.deadline_scope(dl):
+        assert retry.current_deadline() is dl
+        hdrs = retry.inject({})
+        adopted = retry.deadline_from_headers(hdrs)
+    assert retry.current_deadline() is None
+    assert adopted is not None
+    assert abs(adopted.remaining() - dl.remaining()) < 0.5
+    assert retry.deadline_from_headers({}) is None
+    assert retry.deadline_from_headers(
+        {retry.DEADLINE_HEADER: "bogus"}) is None
+
+
+def test_deadline_scope_nesting_and_none():
+    with retry.deadline_scope(None):
+        assert retry.current_deadline() is None
+    with retry.deadline_scope(10.0) as outer:
+        with retry.deadline_scope(1.0) as inner:
+            assert retry.current_deadline() is inner
+        assert retry.current_deadline() is outer
+
+
+def test_expired_deadline():
+    dl = retry.Deadline(0.0)
+    assert dl.expired()
+    assert dl.header_value() == "0.000"
+
+
+# -- classification + backoff ----------------------------------------------
+
+def test_retryable_classification():
+    def http_err(code):
+        return urllib.error.HTTPError("u", code, "m", {}, None)
+    assert retry.retryable(http_err(500))
+    assert retry.retryable(http_err(503))
+    assert retry.retryable(http_err(429))
+    assert not retry.retryable(http_err(404))
+    assert not retry.retryable(http_err(401))
+    assert retry.retryable(urllib.error.URLError("refused"))
+    assert retry.retryable(TimeoutError())
+    assert retry.retryable(ConnectionResetError())
+    assert retry.retryable(faults.FaultError("injected"))
+    assert not retry.retryable(ValueError("nope"))
+
+
+def test_backoff_full_jitter_bounds():
+    pol = retry.RetryPolicy(base_delay=0.1, max_delay=1.0)
+    for attempt in range(8):
+        for _ in range(50):
+            d = pol.backoff(attempt)
+            assert 0 <= d <= min(1.0, 0.1 * 2 ** attempt)
+
+
+# -- http_request against a scripted server --------------------------------
+
+class _Script:
+    """Serve scripted status codes in order, then 200s."""
+
+    def __init__(self, codes):
+        self.codes = list(codes)
+        self.hits = 0
+        self.lock = threading.Lock()
+        handler = self._handler()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return "http://127.0.0.1:%d/x" % self.httpd.server_port
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def _handler(script):  # noqa: N805 — closure over the script
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _serve(self):
+                with script.lock:
+                    script.hits += 1
+                    code = script.codes.pop(0) if script.codes else 200
+                body = b"ok" if code < 400 else b"boom"
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = do_DELETE = _serve
+        return H
+
+
+@pytest.fixture
+def fast_policy():
+    return retry.RetryPolicy(max_attempts=4, base_delay=0.01,
+                             max_delay=0.05, timeout=5.0)
+
+
+def test_http_request_retries_5xx_to_success(fast_policy):
+    srv = _Script([503, 500])
+    try:
+        r = retry.http_request(srv.url, retry_policy=fast_policy)
+        assert r.status == 200 and r.data == b"ok"
+        assert srv.hits == 3
+    finally:
+        srv.close()
+
+
+def test_http_request_4xx_single_attempt(fast_policy):
+    srv = _Script([404])
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            retry.http_request(srv.url, retry_policy=fast_policy)
+        assert ei.value.code == 404
+        assert srv.hits == 1
+    finally:
+        srv.close()
+
+
+def test_http_request_retries_injected_faults(fast_policy):
+    srv = _Script([])
+    faults.inject("test.point", "error#2")
+    try:
+        r = retry.http_request(srv.url, point="test.point",
+                               retry_policy=fast_policy)
+        assert r.status == 200
+        assert srv.hits == 1  # two attempts died pre-wire
+        assert faults.specs()[0]["hits"] == 2
+    finally:
+        srv.close()
+
+
+def test_http_request_mangles_response(fast_policy):
+    srv = _Script([])
+    faults.inject("test.point", "truncate:0.5")
+    try:
+        r = retry.http_request(srv.url, point="test.point",
+                               retry_policy=fast_policy)
+        assert r.data == b"o"
+    finally:
+        srv.close()
+
+
+def test_http_request_deadline_bounds_retries(fast_policy):
+    srv = _Script([500] * 50)
+    try:
+        t0 = time.monotonic()
+        with retry.deadline_scope(0.15):
+            with pytest.raises(urllib.error.HTTPError):
+                retry.http_request(srv.url, retry_policy=fast_policy,
+                                   use_breaker=False)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        srv.close()
+
+
+def test_http_request_exhausted_deadline_raises_deadline_error():
+    with retry.deadline_scope(retry.Deadline(0.0)):
+        with pytest.raises(retry.DeadlineExceeded):
+            retry.http_request("http://127.0.0.1:1/x",
+                               use_breaker=False)
+
+
+# -- circuit breaker -------------------------------------------------------
+
+def test_breaker_state_machine():
+    brk = retry.CircuitBreaker("ep", threshold=3, cooldown=0.1)
+    assert brk.allow()
+    for _ in range(3):
+        brk.record_failure()
+    assert brk.state == "open"
+    assert not brk.allow()
+    time.sleep(0.12)
+    assert brk.allow()          # half-open probe
+    assert brk.state == "half_open"
+    assert not brk.allow()      # only ONE probe in flight
+    brk.record_failure()        # probe failed -> open again
+    assert brk.state == "open"
+    time.sleep(0.12)
+    assert brk.allow()
+    brk.record_success()
+    assert brk.state == "closed" and brk.allow()
+    d = brk.to_dict()
+    assert d["open_count"] == 2 and d["endpoint"] == "ep"
+
+
+def test_breaker_registry_and_payload():
+    a = retry.breaker_for("h:1")
+    assert retry.breaker_for("h:1") is a
+    assert any(b["endpoint"] == "h:1"
+               for b in retry.breakers_payload())
+    retry.reset_breakers()
+    assert retry.breakers_payload() == []
+
+
+# -- replica push path under faults (ISSUE satellite) ----------------------
+
+def test_replicate_http_transient_5xx_retries_succeed(monkeypatch,
+                                                      fast_policy):
+    from seaweedfs_tpu.cluster.volume_server import _replicate_http
+    monkeypatch.setattr(retry, "_POLICY", fast_policy)
+    srv = _Script([502, 503])
+    try:
+        peer = srv.url.split("//")[1].split("/")[0]
+        _replicate_http(peer, "3,0123cafe", b"needle-bytes")
+        assert srv.hits == 3  # two 5xx + the success
+    finally:
+        srv.close()
+
+
+def test_replicate_http_permanent_4xx_no_retry(monkeypatch, fast_policy):
+    from seaweedfs_tpu.cluster.volume_server import _replicate_http
+    monkeypatch.setattr(retry, "_POLICY", fast_policy)
+    srv = _Script([401])
+    try:
+        peer = srv.url.split("//")[1].split("/")[0]
+        with pytest.raises(urllib.error.HTTPError):
+            _replicate_http(peer, "3,0123cafe", b"x")
+        assert srv.hits == 1
+    finally:
+        srv.close()
+
+
+def test_replicate_http_breaker_opens_and_recovers(monkeypatch):
+    from seaweedfs_tpu.cluster.volume_server import _replicate_http
+    pol = retry.RetryPolicy(max_attempts=1, base_delay=0.01,
+                            timeout=5.0, breaker_threshold=3,
+                            breaker_cooldown=0.15)
+    monkeypatch.setattr(retry, "_POLICY", pol)
+    srv = _Script([500, 500, 500])
+    try:
+        peer = srv.url.split("//")[1].split("/")[0]
+        for _ in range(3):
+            with pytest.raises(urllib.error.HTTPError):
+                _replicate_http(peer, "3,0123cafe", b"x")
+        # threshold hit: next call fails FAST without touching the wire
+        with pytest.raises(retry.BreakerOpenError):
+            _replicate_http(peer, "3,0123cafe", b"x")
+        assert srv.hits == 3
+        brk = retry.breaker_for(peer)
+        assert brk.state == "open"
+        time.sleep(0.2)  # cooldown -> half-open probe; server now 200s
+        _replicate_http(peer, "3,0123cafe", b"x")
+        assert brk.state == "closed"
+    finally:
+        srv.close()
+
+
+# -- wdclient election wait bounded by deadline (ISSUE satellite) ----------
+
+def test_wdclient_unknown_leader_loop_respects_deadline():
+    from seaweedfs_tpu.cluster.wdclient import MasterClient
+    mc = MasterClient("127.0.0.1:1,127.0.0.1:2")
+
+    def always_electing():
+        raise RuntimeError("raft: not the leader (leader unknown)")
+
+    t0 = time.monotonic()
+    with retry.deadline_scope(0.4):
+        with pytest.raises(RuntimeError):
+            mc._with_failover(always_electing)
+    assert time.monotonic() - t0 < 3.0  # bounded, never spins forever
+
+
+# -- shell commands --------------------------------------------------------
+
+def test_shell_fault_commands(tmp_path):
+    import io
+
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.shell.commands import ShellError
+    from seaweedfs_tpu.storage.store import Store
+
+    out = io.StringIO()
+    env = CommandEnv(store=Store([tmp_path]), out=out)
+    run_command(env, "fault.inject -point volume.read -spec error@0.5#2")
+    assert any(s["point"] == "volume.read" for s in faults.specs())
+    run_command(env, "fault.list")
+    text = out.getvalue()
+    assert "volume.read=error@0.5#2" in text
+    assert "ec.shard_read" in text  # catalog listed
+    with pytest.raises(ShellError):
+        run_command(env, "fault.inject -point p -spec explode")
+    run_command(env, "fault.clear -breakers")
+    assert faults.specs() == []
+
+
+# -- surfacing -------------------------------------------------------------
+
+def test_varz_payload_has_breakers_and_faults():
+    from seaweedfs_tpu.util import varz
+    faults.inject("p", "error#1")
+    retry.breaker_for("host:9")
+    doc = json.loads(json.dumps(varz.payload("test")))
+    assert doc["faults"]["specs"][0]["point"] == "p"
+    assert doc["breakers"][0]["endpoint"] == "host:9"
+
+
+def test_config_scaffolds_cover_retry_and_faults():
+    from seaweedfs_tpu.util import config as config_mod
+    assert "[retry]" in config_mod.SCAFFOLDS["retry"]
+    assert "[faults]" in config_mod.SCAFFOLDS["faults"]
+
+
+def test_degraded_counter_labels():
+    before = retry.METRICS.counter("degraded_reads_total",
+                                   stage="unit_test").value
+    retry.record_degraded("unit_test")
+    after = retry.METRICS.counter("degraded_reads_total",
+                                  stage="unit_test").value
+    assert after == before + 1
+    assert "seaweed_degraded_reads_total" in retry.METRICS.render()
+
+
+# -- the layer is the only road (grep-verifiable acceptance bar) -----------
+
+def test_no_bare_urlopen_in_clients():
+    """No module under cluster/, replication/, or gateway/ may bypass
+    the resilience layer with a direct ``urllib.request.urlopen``."""
+    offenders = []
+    for sub in ("cluster", "replication", "gateway"):
+        for p in (REPO / "seaweedfs_tpu" / sub).rglob("*.py"):
+            if "urllib.request.urlopen" in p.read_text(encoding="utf-8"):
+                offenders.append(str(p.relative_to(REPO)))
+    assert not offenders, (
+        f"bare urlopen bypasses util/retry.py in: {offenders}")
