@@ -21,5 +21,18 @@ if [ "$rc" -ne 0 ]; then
     echo "lint_gate: NEW analyzer findings above (exit $rc)." >&2
     echo "lint_gate: fix them, pragma them with a reason, or" \
          "re-baseline with scripts/seaweedlint --write-baseline" >&2
+    exit "$rc"
+fi
+
+# Overlapped-ingest correctness smoke (docs/pipeline.md): the pipeline
+# must produce byte-identical shards to the synchronous path. A small
+# volume keeps this under a few seconds while still spanning batches.
+bash scripts/pipeline_smoke.sh $((8 * 1024 * 1024))
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: pipeline_smoke failed (exit $rc) — the" \
+         "overlapped encode path diverged from the synchronous" \
+         "reference; see scripts/pipeline_smoke.sh" >&2
 fi
 exit "$rc"

@@ -1146,6 +1146,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     telemetry_mod.configure_from(conf)
     retry.configure_from(conf)
     faults.configure_from(conf)
+    from ..pipeline import pipe as pipe_mod
+    pipe_mod.configure_from(conf)
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,
                   needle_map=args.index)
     store.load_existing()

@@ -59,6 +59,16 @@ HOST_DISPATCH = os.environ.get("SEAWEEDFS_TPU_HOST_DISPATCH", "auto")
 #: remote-compile ceiling is per-BUFFER, not per-program (PERF.md), so
 #: grouping scales throughput without approaching the compile limit.
 DISPATCH_GROUP = os.environ.get("SEAWEEDFS_TPU_DISPATCH_GROUP", "16")
+#: HBM reuse on the host-slab fast path: donate the freshly transferred
+#: word-form arg to the jitted call (jax.jit donate_argnums) so XLA may
+#: recycle its device memory for the computation instead of holding
+#: input and output live together — a streaming encode keeps up to
+#: group x batch slabs in flight, so without donation peak HBM is
+#: roughly double the working set. "auto" (default) donates only on
+#: accelerator backends: on CPU, jnp.asarray may ALIAS the host numpy
+#: buffer (no transfer happens), and donating an aliased buffer would
+#: hand the pooled batch the writer still references to XLA as scratch.
+DONATE = os.environ.get("SEAWEEDFS_TPU_DONATE", "auto")
 _link_gibps: Optional[float] = None
 _native_gibps: Optional[float] = None
 _calibrate_lock = threading.Lock()
@@ -87,6 +97,38 @@ def _dispatch_mode() -> str:
             f"SEAWEEDFS_TPU_HOST_DISPATCH={HOST_DISPATCH!r}: expected "
             f"'auto', 'device' or 'native'")
     return HOST_DISPATCH
+
+
+_donation_warning_squelched = False
+
+
+def _donate() -> bool:
+    """Validated DONATE knob (see its comment). Donation that XLA
+    cannot alias (parity output is m/k the input size) still frees the
+    input buffer inside the computation — that early release, not
+    output aliasing, is the HBM win — but JAX warns about every such
+    call, so the warning is squelched once when donation first engages.
+    """
+    if DONATE not in ("auto", "on", "off"):
+        raise ValueError(
+            f"SEAWEEDFS_TPU_DONATE={DONATE!r}: expected "
+            f"'auto', 'on' or 'off'")
+    if DONATE == "off":
+        return False
+    # deliberately the RAW backend, not _use_pallas(): tests monkeypatch
+    # that predicate to force the device path on CPU (interpret-mode
+    # kernels), and donating there is exactly the aliasing hazard the
+    # auto mode exists to rule out
+    on = True if DONATE == "on" \
+        else jax.default_backend() in ("tpu", "axon")
+    if on:
+        global _donation_warning_squelched
+        if not _donation_warning_squelched:
+            import warnings
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            _donation_warning_squelched = True
+    return on
 #: Which Pallas kernel the auto "pallas" variant uses: "transpose"
 #: (default — oracle-smoked on hardware every bench round) or "swar"
 #: (transpose-free; see rs_pallas.apply_gf_matrix_swar). Resolution
@@ -217,33 +259,30 @@ def _device_worth_it() -> bool:
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
+def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str,
+                  donate: bool = False):
     """One jitted executable per (coefficient matrix, backend variant);
-    shapes stay polymorphic via jit's own shape cache."""
+    shapes stay polymorphic via jit's own shape cache. ``donate`` hands
+    the input buffer to XLA (host word-form call sites only — they pass
+    a freshly transferred device copy nothing else references)."""
     coefs = np.frombuffer(coefs_bytes, dtype=np.uint8).reshape(n_out, n_in)
 
     if variant == "pallas":
-        @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
             return rs_pallas.apply_gf_matrix(coefs, x)
     elif variant == "pallas_swar":
-        @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
             return rs_pallas.apply_gf_matrix_swar(coefs, x)
     elif variant == "pallas_words":
-        @jax.jit
         def apply_fn(x4: jnp.ndarray) -> jnp.ndarray:
             return rs_pallas.apply_gf_matrix_words(coefs, x4)
     elif variant == "pallas_swar_words":
-        @jax.jit
         def apply_fn(x4: jnp.ndarray) -> jnp.ndarray:
             return rs_pallas.apply_gf_matrix_swar_words(coefs, x4)
     elif variant == "xla":
-        @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
             return bitslice.apply_gf_matrix(coefs, x)
     else:  # "xla_chunked": x is (B, n_in, nc, sc)
-        @jax.jit
         def apply_fn(x: jnp.ndarray) -> jnp.ndarray:
             # lax.map over column chunks keeps live intermediates to one
             # chunk's worth while XLA still fuses within each step.
@@ -252,17 +291,21 @@ def _jitted_apply(coefs_bytes: bytes, n_out: int, n_in: int, variant: str):
                 lambda v: bitslice.apply_gf_matrix(coefs, v), xc)
             return yc.transpose(1, 2, 0, 3)
 
-    return apply_fn
+    return jax.jit(apply_fn, donate_argnums=(0,)) if donate \
+        else jax.jit(apply_fn)
 
 
 @functools.lru_cache(maxsize=64)
 def _jitted_apply_multi(coefs_bytes: bytes, n_out: int, n_in: int,
-                        variant: str, nargs: int):
+                        variant: str, nargs: int, donate: bool = False):
     """One jitted executable per (coefficient matrix, words variant,
     group width): nargs word-form slabs in, nargs parities out. One
     dispatch for the whole group — the production analog of the bench
     race's n16 candidate (PERF.md: the launch+sync floor, not the
-    kernel, dominates single-slab calls)."""
+    kernel, dominates single-slab calls). ``donate`` hands every slab
+    arg to XLA — the streaming pipeline's HBM high-water mark drops
+    from (inputs + outputs) to one group of inputs, since each slab's
+    buffer frees as the computation consumes it."""
     coefs = np.frombuffer(coefs_bytes, dtype=np.uint8).reshape(n_out, n_in)
     if variant == "pallas_swar_words":
         def kern(x):
@@ -271,12 +314,12 @@ def _jitted_apply_multi(coefs_bytes: bytes, n_out: int, n_in: int,
         def kern(x):
             return rs_pallas.apply_gf_matrix_words(coefs, x)
 
-    @jax.jit
     def apply_fn(*xs):
         assert len(xs) == nargs
         return tuple(kern(x) for x in xs)
 
-    return apply_fn
+    return jax.jit(apply_fn, donate_argnums=tuple(range(nargs))) \
+        if donate else jax.jit(apply_fn)
 
 
 class _HostParity:
@@ -322,7 +365,8 @@ def apply_matrix_host(coefs: np.ndarray, batch):
             return rs_native.apply_gf_matrix(coefs, batch)
         variant, xw = wf
         b, _, s = batch.shape
-        fn = _jitted_apply(coefs.tobytes(), n_out, n_in, variant)
+        fn = _jitted_apply(coefs.tobytes(), n_out, n_in, variant,
+                           donate=_donate())
         return _HostParity(fn(jnp.asarray(xw)), b, n_out, s)
     if _host_prefers_native(n_in, batch):
         return rs_native.apply_gf_matrix(coefs, batch)
@@ -418,11 +462,12 @@ def apply_matrix_host_multi(coefs: np.ndarray, batches):
             # already built
             i = ixs[0]
             b, _, s = batches[i].shape
-            fn = _jitted_apply(coefs.tobytes(), n_out, n_in, g_variant)
+            fn = _jitted_apply(coefs.tobytes(), n_out, n_in, g_variant,
+                               donate=_donate())
             out[i] = _HostParity(fn(jnp.asarray(xws[0])), b, n_out, s)
             return
         fn = _jitted_apply_multi(coefs.tobytes(), n_out, n_in,
-                                 g_variant, width)
+                                 g_variant, width, donate=_donate())
         ys = fn(*[jnp.asarray(x) for x in xws])
         for i, y in zip(ixs, ys):
             b, _, s = batches[i].shape

@@ -30,11 +30,12 @@ from typing import Callable, Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 from ..storage import ec_files, volume as volume_mod
-from . import pipe
+from . import pipe, writeback
 from .scheme import DEFAULT_SCHEME, EcScheme
 from .stripe import iter_row_batches, stripe_rows
 
-#: Bound on bytes packed into one coalesced device batch (input side).
+#: Bound on bytes packed into one coalesced device batch (input side);
+#: the live value is ``[pipeline] batch_bytes`` (pipe.current()).
 DEFAULT_MAX_BATCH_BYTES = 256 * 1024 * 1024
 
 
@@ -132,7 +133,7 @@ def iter_packed_batches(sources: Iterable[tuple[object, np.ndarray]],
 def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
                   sink: Callable[[object, int, int, np.ndarray], None],
                   scheme: EcScheme = DEFAULT_SCHEME,
-                  max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES) -> int:
+                  max_batch_bytes: Optional[int] = None) -> int:
     """Coalesced encode over many volumes with the 3-stage pipeline.
 
     ``sink(key, shard_id, offset, blocks)`` receives each span's bytes
@@ -142,6 +143,8 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
     (zero-copy) or flatten (ravel/reshape copies on demand). Data
     shards come straight from the host batch, parity from the device.
     Returns total input bytes."""
+    if max_batch_bytes is None:
+        max_batch_bytes = pipe.current().batch_bytes
     k = scheme.data_shards
     total = 0
 
@@ -174,7 +177,8 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
     multi, group, max_batch_bytes = pipe.pick_grouped_dispatch(
         scheme.encoder.encode_parity_host_multi, max_batch_bytes)
     pipe.run_pipeline(batches(), _pick_encode_fn(scheme), write,
-                      encode_multi_fn=multi, group=group)
+                      encode_multi_fn=multi, group=group,
+                      kind="ec.batch")
     return total
 
 
@@ -198,7 +202,7 @@ def _pick_encode_fn(scheme: EcScheme):
 
 def encode_many(payloads: Sequence[np.ndarray],
                 scheme: EcScheme = DEFAULT_SCHEME,
-                max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES,
+                max_batch_bytes: Optional[int] = None,
                 keep_output: bool = False):
     """In-memory coalesced encode of many volume payloads.
 
@@ -237,7 +241,7 @@ def encode_many(payloads: Sequence[np.ndarray],
 
 def encode_volumes(bases: Sequence[str | Path],
                    scheme: EcScheme = DEFAULT_SCHEME,
-                   max_batch_bytes: int = DEFAULT_MAX_BATCH_BYTES
+                   max_batch_bytes: Optional[int] = None
                    ) -> int:
     """Seal many volumes' .dat files into shard files via coalesced
     batches (the file-level config-3 path used by ``ec.encode`` over a
@@ -245,33 +249,42 @@ def encode_volumes(bases: Sequence[str | Path],
     write_ecx_file / VolumeInfo per volume as in single-volume encode.
     Returns total .dat bytes encoded."""
     bases = [str(b) for b in bases]
-    outs: dict[tuple[str, int], object] = {}
+    shard_sizes: dict[str, int] = {}
+    # spans address disjoint shard-file byte ranges, so writes go to
+    # the positioned-write pool (preallocated files, pwritev) and
+    # retire while the next batch packs/computes — same writeback
+    # plane as single-volume encode (pipeline/writeback.py). The span
+    # views keep the source memmap alive until their write lands.
+    writer = writeback.WriterPool()
 
     def sources():
         for b in bases:
             datp = volume_mod.dat_path(b)
+            size = datp.stat().st_size
+            shard_sizes[b] = scheme.shard_file_size(size)
             dat = np.memmap(datp, dtype=np.uint8, mode="r") \
-                if datp.stat().st_size else np.zeros(0, dtype=np.uint8)
+                if size else np.zeros(0, dtype=np.uint8)
             yield b, dat
 
     def sink(base, shard_id, offset, blocks):
-        f = outs.get((base, shard_id))
-        if f is None:
-            f = open(ec_files.shard_path(base, shard_id), "wb")
-            outs[(base, shard_id)] = f
-        f.seek(offset)
+        path = str(ec_files.shard_path(base, shard_id))
+        writer.open_file(path, shard_sizes[base])
         if blocks.ndim > 1 and \
                 blocks.shape[-1] >= pipe.ROW_WRITE_MIN_BLOCK:
             # (n, block) span view: rows are contiguous even when the
-            # span itself is strided — write them without a gather copy
+            # span itself is strided — queue them without a gather copy
             # (tiny blocks take the copy path; see pipe.py)
-            for row in blocks:
-                f.write(row.data)
+            writer.submit(path, offset,
+                          [blocks[r] for r in range(blocks.shape[0])])
         else:
-            f.write(np.ascontiguousarray(blocks).data)
+            writer.submit(path, offset,
+                          [np.ascontiguousarray(blocks).reshape(-1)])
 
     try:
-        return encode_packed(sources(), sink, scheme, max_batch_bytes)
+        total = encode_packed(sources(), sink, scheme, max_batch_bytes)
+        writer.close()
+        writer = None
+        return total
     finally:
-        for f in outs.values():
-            f.close()
+        if writer is not None:
+            writer.abort()

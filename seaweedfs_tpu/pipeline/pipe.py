@@ -1,21 +1,33 @@
-"""3-stage host→device→host pipeline with double buffering.
+"""Overlapped ingest plane: host→device→host pipeline with buffer reuse.
 
-SURVEY.md §7 hard part 1: the EC encode targets are bound by host↔device
-transfer, not GF math, so disk reads, H2D+compute, and D2H+disk writes
-must overlap. JAX's async dispatch gives the overlap for free once the
-stages run on separate threads with bounded queues:
+SURVEY.md §7 hard part 1 and ROADMAP open item #1: BENCH_r05 measured
+119 GiB/s device-side RS compute but 0.006 GiB/s end-to-end streaming
+encode — the hot loop lifted from ec_encoder.go's read→Encode→write is
+host-bound, not math-bound. This module is the tf.data-style answer
+(Murray et al., VLDB 2021): overlap ingest, transfer, compute and
+writeback so the device never waits on the host, and recycle every
+buffer so the steady state allocates nothing.
 
-- a reader thread materializes host batches (memmap slices → contiguous
-  uint8) and feeds a depth-limited queue;
-- the main thread enqueues ``device_put`` + the jitted encode, which
-  return immediately (device work proceeds in the background);
+- a reader thread materializes host batches (``os.preadv`` straight
+  into a pool of reusable page-aligned buffers — see
+  :class:`HostBufferPool`) and feeds a depth-limited queue;
+- the main thread enqueues the jitted encode, which returns
+  immediately (device work proceeds in the background); on a single
+  accelerator, runs of same-shaped batches share ONE dispatch
+  (``apply_matrix_host_multi``), with a :class:`GroupController`
+  sizing the group from measured stage latencies;
 - a writer thread calls ``np.asarray`` on the oldest in-flight result —
   blocking until THAT batch's compute is done while newer batches are
-  still being transferred/computed — and appends to the shard files.
+  still being transferred/computed — and hands shard bytes to a
+  positioned-write pool (pipeline/writeback.py) that runs pwritev
+  calls on preallocated files while the next batch computes.
 
-Queue depths of 2 bound host memory at ~4 batches and keep one batch in
-flight on device while the previous drains and the next loads. The same
-loop pipelines the CPU path (reader/writer overlap still helps there).
+Queue depths, batch bounds, writer width and the group cap all come
+from the ``[pipeline]`` TOML section (:func:`configure_from`); the
+module constants below are only the hard defaults underneath it.
+Per-batch stage latencies feed ``trace_request_stage_seconds{stage=
+pipe.read|pipe.compute|pipe.write}`` and per-pipeline throughput
+counters surface in ``/debug/vars`` (:func:`debug_payload`).
 
 Reference analog: ec_encoder.go encodeDatFile's sequential
 read→Encode→write loop (SURVEY.md §3.1 hot loop), restructured for an
@@ -24,78 +36,461 @@ accelerator's async queue instead of a synchronous SIMD call.
 
 from __future__ import annotations
 
+import mmap
 import queue
 import threading
-from typing import Any, Callable, Iterable, Iterator, Optional
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
-#: Stage-queue depth: 2 = classic double buffering.
+#: Stage-queue depth: 2 = classic double buffering (config default).
 DEPTH = 2
 
 #: Row-view shard writes need rows at least this long: below it the
-#: per-row Python write() overhead beats the strided gather-copy it
-#: avoids (a 256-byte-block scheme would make ~1.4M tiny writes per
-#: 256 MiB batch), so smaller blocks take the copy+tofile path.
+#: per-row write overhead beats the strided gather-copy it avoids (a
+#: 256-byte-block scheme would make ~1.4M tiny writes per 256 MiB
+#: batch), so smaller blocks take the copy path.
 ROW_WRITE_MIN_BLOCK = 64 * 1024
 
 #: Bound on one batch's INPUT bytes while grouped dispatch is active:
 #: the pipeline queues then hold up to `group` batches each, so the
 #: per-batch size shrinks to keep host memory and the ~160 MiB
 #: per-buffer remote-compile ceiling (PERF.md) bounded while one
-#: dispatch still carries group x this.
+#: dispatch still carries group x this (config default).
 GROUPED_BATCH_BYTES = 64 * 1024 * 1024
 
 _END = object()
 
 
+# --------------------------------------------------------------------------
+# configuration — the [pipeline] TOML section
+# --------------------------------------------------------------------------
+
+@dataclass
+class PipelineConfig:
+    """Tuning knobs of the overlapped ingest plane (docs/pipeline.md).
+
+    Flags > TOML > these defaults, like every other subsystem
+    (util/config.py). ``0`` means "derive": ``group_cap`` defers to
+    ``SEAWEEDFS_TPU_DISPATCH_GROUP``, ``pool_buffers`` is sized from
+    depth+group so groups can actually form.
+    """
+
+    depth: int = DEPTH                       # stage-queue depth
+    batch_bytes: int = 256 * 1024 * 1024     # max input bytes per batch
+    grouped_batch_bytes: int = GROUPED_BATCH_BYTES
+    group_cap: int = 0                       # max batches per dispatch
+    writer_threads: int = 4                  # shard-writeback pool width
+    writer_queue_depth: int = 4              # pending jobs per writer
+    pool_buffers: int = 0                    # reusable host buffers
+    feedback: bool = True                    # stage-latency controller
+    overlapped: bool = True                  # False = synchronous path
+    preallocate: bool = True                 # size shard files up front
+
+
+_CONFIG = PipelineConfig()
+
+
+def current() -> PipelineConfig:
+    return _CONFIG
+
+
+def configure(**kw) -> None:
+    """Set config fields; None values keep their current setting."""
+    for key, val in kw.items():
+        if not hasattr(_CONFIG, key):
+            raise TypeError(f"unknown pipeline config key {key!r}")
+        if val is not None:
+            cur = getattr(_CONFIG, key)
+            setattr(_CONFIG, key, type(cur)(val))
+
+
+def configure_from(conf: dict) -> None:
+    """Apply a loaded TOML dict's ``[pipeline]`` block (missing keys
+    keep their current values)."""
+    from ..util import config as config_mod
+    sect = config_mod.lookup(conf, "pipeline")
+    if not isinstance(sect, dict):
+        return
+    configure(**{k: sect.get(k) for k in (
+        "depth", "batch_bytes", "grouped_batch_bytes", "group_cap",
+        "writer_threads", "writer_queue_depth", "pool_buffers",
+        "feedback", "overlapped", "preallocate")})
+
+
 def pick_grouped_dispatch(multi_fn, max_bytes: int,
-                          cap_bytes: int = GROUPED_BATCH_BYTES):
+                          cap_bytes: Optional[int] = None):
     """ONE grouping policy for the encode / coalescing-batcher /
     rebuild pipelines: returns (multi_fn or None, group, max_bytes).
 
     Group width comes from rs_jax.host_dispatch_group() — >1 only on a
     single-device accelerator (multi-chip paths mesh-shard each batch
     via parallel/mesh instead; CPU backends never take the word-form
-    device path). When grouping is on, the per-item byte bound is
-    clamped to ``cap_bytes`` (see GROUPED_BATCH_BYTES)."""
+    device path) — clamped by ``[pipeline] group_cap`` when set. When
+    grouping is on, the per-item byte bound is clamped to ``cap_bytes``
+    (default: ``[pipeline] grouped_batch_bytes``)."""
     from ..ops import rs_jax
+    if cap_bytes is None:
+        cap_bytes = _CONFIG.grouped_batch_bytes
     group = rs_jax.host_dispatch_group()
+    if _CONFIG.group_cap:
+        group = min(group, _CONFIG.group_cap)
     if group <= 1:
         return None, 1, max_bytes
     return multi_fn, group, min(max_bytes, cap_bytes)
+
+
+# --------------------------------------------------------------------------
+# reusable page-aligned host buffers
+# --------------------------------------------------------------------------
+
+class HostBufferPool:
+    """A fixed set of reusable page-aligned host buffers.
+
+    Buffers are anonymous ``mmap`` regions (page-aligned by
+    construction — the closest a CPU host gets to pinned memory), so
+    steady-state ingest never pays per-batch allocation + zeroing, and
+    readv/preadv can scatter file bytes straight into them.
+    ``acquire`` blocks when every buffer is in flight — that blocking
+    IS the ingest plane's host-memory bound."""
+
+    def __init__(self, nbytes: int, count: int):
+        if nbytes <= 0 or count <= 0:
+            raise ValueError("nbytes and count must be positive")
+        self.nbytes = nbytes
+        self.count = count
+        self._free: queue.Queue = queue.Queue()
+        self._maps: list[mmap.mmap] = []
+        for _ in range(count):
+            m = mmap.mmap(-1, nbytes)
+            self._maps.append(m)
+            self._free.put(np.frombuffer(m, dtype=np.uint8))
+
+    def acquire(self, timeout: Optional[float] = None) -> np.ndarray:
+        """A free (nbytes,) uint8 buffer; blocks until one is
+        recycled. Raises ``queue.Empty`` on timeout."""
+        return self._free.get(timeout=timeout) if timeout is not None \
+            else self._free.get()
+
+    def release(self, buf: np.ndarray) -> None:
+        """Return a buffer obtained from :meth:`acquire`."""
+        self._free.put(buf)
+
+    def in_flight(self) -> int:
+        return self.count - self._free.qsize()
+
+
+# --------------------------------------------------------------------------
+# stage metrics
+# --------------------------------------------------------------------------
+
+@dataclass
+class PipeStats:
+    """Per-run stage accounting. Each field is written by exactly one
+    stage thread and read after the join, so no locking is needed."""
+
+    batches: int = 0
+    groups: int = 0                 # compute dispatch steps
+    max_group: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+    read_seconds: float = 0.0       # batch materialization (reader)
+    dispatch_seconds: float = 0.0   # encode_fn enqueue (main thread)
+    sync_seconds: float = 0.0       # np.asarray device wait (writer)
+    write_seconds: float = 0.0      # write_fn + positioned writes
+    wall_seconds: float = 0.0
+
+    @property
+    def compute_seconds(self) -> float:
+        """Device-side stage time: dispatch + the D2H sync wait."""
+        return self.dispatch_seconds + self.sync_seconds
+
+    def stage_seconds(self) -> dict:
+        """The reader/compute/writer breakdown (bench extras shape)."""
+        return {"read": round(self.read_seconds, 6),
+                "compute": round(self.compute_seconds, 6),
+                "write": round(self.write_seconds, 6),
+                "wall": round(self.wall_seconds, 6)}
+
+    def to_dict(self) -> dict:
+        d = self.stage_seconds()
+        d.update(batches=self.batches, groups=self.groups,
+                 max_group=self.max_group, bytes_in=self.bytes_in,
+                 bytes_out=self.bytes_out,
+                 dispatch_seconds=round(self.dispatch_seconds, 6),
+                 sync_seconds=round(self.sync_seconds, 6))
+        if self.wall_seconds > 0:
+            d["gibps"] = round(
+                self.bytes_in / (1 << 30) / self.wall_seconds, 3)
+        return d
+
+
+#: Process-lifetime totals + a short ring of completed-run snapshots,
+#: surfaced at /debug/vars on every server (util/varz.py) and by the
+#: pipeline.status shell command.
+_TELEMETRY_LOCK = threading.Lock()
+_TOTALS = {"runs": 0, "batches": 0, "bytes_in": 0, "bytes_out": 0,
+           "read_seconds": 0.0, "compute_seconds": 0.0,
+           "write_seconds": 0.0, "wall_seconds": 0.0}
+RECENT: deque = deque(maxlen=8)
+
+
+def publish_stats(stats: "PipeStats", kind: str = "pipe") -> None:
+    """Fold one completed run into the process totals + recent ring."""
+    with _TELEMETRY_LOCK:
+        _TOTALS["runs"] += 1
+        _TOTALS["batches"] += stats.batches
+        _TOTALS["bytes_in"] += stats.bytes_in
+        _TOTALS["bytes_out"] += stats.bytes_out
+        _TOTALS["read_seconds"] += stats.read_seconds
+        _TOTALS["compute_seconds"] += stats.compute_seconds
+        _TOTALS["write_seconds"] += stats.write_seconds
+        _TOTALS["wall_seconds"] += stats.wall_seconds
+        entry = {"kind": kind}
+        entry.update(stats.to_dict())
+        RECENT.append(entry)
+
+
+def last_run() -> Optional[dict]:
+    """Most recent completed run's snapshot (bench stage breakdown)."""
+    with _TELEMETRY_LOCK:
+        return dict(RECENT[-1]) if RECENT else None
+
+
+def debug_payload() -> dict:
+    """/debug/vars section: totals + the recent-run ring."""
+    with _TELEMETRY_LOCK:
+        out = {k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in _TOTALS.items()}
+        out["recent"] = [dict(e) for e in RECENT]
+    return out
+
+
+def reset_telemetry() -> None:
+    """Drop totals and the recent ring (tests)."""
+    with _TELEMETRY_LOCK:
+        for k in _TOTALS:
+            _TOTALS[k] = 0 if isinstance(_TOTALS[k], int) else 0.0
+        RECENT.clear()
+
+
+#: stage name -> latency histogram + bytes counter in the tracing
+#: metrics family, so the pipeline's stage breakdown lands in the same
+#: ``trace_request_stage_seconds{stage=...}`` series every other
+#: subsystem reports into (PR 2 conventions). Cached like
+#: tracing._INSTRUMENTS: plain dict, a rare double-create just wins
+#: the same registry entry.
+_STAGE_INSTRUMENTS: dict = {}
+
+
+def _stage_observe(stage: str, seconds: float, nbytes: int = 0) -> None:
+    tup = _STAGE_INSTRUMENTS.get(stage)
+    if tup is None:
+        from ..util import tracing
+        tup = (tracing.METRICS.histogram("request_stage_seconds",
+                                         stage=stage),
+               tracing.METRICS.counter("stage_bytes_total", stage=stage))
+        _STAGE_INSTRUMENTS[stage] = tup
+    tup[0].observe(seconds)
+    if nbytes:
+        tup[1].inc(nbytes)
+
+
+# --------------------------------------------------------------------------
+# feedback controller for grouped dispatch
+# --------------------------------------------------------------------------
+
+class GroupController:
+    """Sizes grouped dispatch from measured stage latencies.
+
+    The per-dispatch launch+sync floor dominates single-slab device
+    calls (PERF.md round-5 race: 4.3 -> 119 GiB/s at n=16), so wider
+    groups amortize it — but only when the reader can actually keep a
+    group's worth of batches queued, and only while per-batch dispatch
+    cost keeps falling with width. Hill-climb on the width:
+
+    - after each dispatch, EWMA the per-BATCH dispatch seconds at that
+      width; widen (x2, up to the cap) while wider stays cheaper per
+      batch, back off when it measures worse than half the width;
+    - when the reader repeatedly can't fill the current target
+      (starvation), halve the target — waiting for a group that never
+      forms would add latency without amortizing anything.
+
+    ``wait_seconds`` bounds how long the compute stage may block for
+    one more batch while a group forms: one EWMA read latency, capped —
+    if the reader can't produce within its own recent pace, it is
+    starved and the group dispatches as-is.
+    """
+
+    WAIT_CAP = 0.05        # never stall dispatch more than this per slot
+    ALPHA = 0.4            # EWMA weight for new measurements
+    WORSE = 1.05           # hysteresis: "wider got worse" margin
+
+    def __init__(self, cap: int):
+        self.cap = max(1, int(cap))
+        self.width = min(2, self.cap)
+        self._per_batch: dict[int, float] = {}
+        self._ewma_read = 0.0
+        self._starve = 0.0
+
+    def note_read(self, seconds: float) -> None:
+        self._ewma_read = seconds if not self._ewma_read else \
+            (1 - self.ALPHA) * self._ewma_read + self.ALPHA * seconds
+
+    def note_dispatch(self, seconds: float, width: int) -> None:
+        width = max(1, width)
+        pb = seconds / width
+        cur = self._per_batch.get(width)
+        self._per_batch[width] = pb if cur is None else \
+            (1 - self.ALPHA) * cur + self.ALPHA * pb
+        half = self._per_batch.get(max(1, width // 2))
+        if width > 1 and half is not None \
+                and self._per_batch[width] > half * self.WORSE:
+            self.width = max(1, width // 2)
+        elif width >= self.width and self._starve < 0.5:
+            self.width = min(self.cap, max(width, self.width) * 2)
+
+    def note_starved(self) -> None:
+        self._starve = (1 - self.ALPHA) * self._starve + self.ALPHA
+        if self._starve > 0.8:
+            self.width = max(1, self.width // 2)
+
+    def note_supplied(self) -> None:
+        self._starve = (1 - self.ALPHA) * self._starve
+
+    def target(self) -> int:
+        return self.width
+
+    def wait_seconds(self) -> float:
+        if self.width <= 1:
+            return 0.0
+        return min(self._ewma_read or self.WAIT_CAP, self.WAIT_CAP)
 
 
 class PipelineError(RuntimeError):
     pass
 
 
+# --------------------------------------------------------------------------
+# the pipeline
+# --------------------------------------------------------------------------
+
 def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
                  encode_fn: Callable[[np.ndarray], Any],
                  write_fn: Callable[[Any, np.ndarray, np.ndarray], None],
-                 depth: int = DEPTH,
+                 depth: Optional[int] = None,
                  encode_multi_fn: Optional[
                      Callable[[list], list]] = None,
-                 group: int = 1) -> int:
+                 group: int = 1,
+                 recycle_fn: Optional[
+                     Callable[[Any, np.ndarray], None]] = None,
+                 stats: Optional[PipeStats] = None,
+                 overlapped: Optional[bool] = None,
+                 controller: Optional[GroupController] = None,
+                 kind: str = "pipe",
+                 publish: bool = True) -> int:
     """Drive (meta, host_batch) items through encode_fn with full
     read/compute/write overlap.
 
     ``encode_fn(batch)`` must return an asynchronously computed device
     value (or a host array — the loop still overlaps read and write);
     ``write_fn(meta, batch, result_np)`` runs on the writer thread in
-    FIFO order, so per-file appends stay ordered. Returns the number of
-    batches processed. Exceptions from any stage propagate.
+    FIFO order, so per-file appends stay ordered; ``recycle_fn(meta,
+    batch)``, when given, runs on the writer thread after ``write_fn``
+    returns — the hook pooled-buffer readers use to hand slabs back.
+    Returns the number of batches processed. Exceptions from any stage
+    propagate as :class:`PipelineError`.
 
     When ``encode_multi_fn`` is given with ``group > 1``, the compute
-    stage drains up to ``group`` already-read batches per step and
-    dispatches them together (one device call on the word-form path —
-    rs_jax.apply_matrix_host_multi), amortizing the per-dispatch floor
-    that dominates single-slab device calls (PERF.md round-5 race).
-    Grouping is greedy, never waiting on the reader: when the device
-    outruns the disk the group degrades to 1 and latency is unchanged;
-    when the disk outruns the device the read queue fills and full
-    groups form. Queue depth grows to ``group`` so groups CAN form —
-    host memory is bounded by the caller's batch size times group."""
+    stage drains up to a target number of already-read batches per step
+    and dispatches them together (one device call on the word-form
+    path — rs_jax.apply_matrix_host_multi), amortizing the per-dispatch
+    floor that dominates single-slab device calls (PERF.md round-5
+    race). The target comes from a :class:`GroupController` fed with
+    measured stage latencies (``[pipeline] feedback``; pass
+    ``controller`` to share one across runs) — it may briefly wait for
+    a group to form while the measured amortization pays for the wait,
+    and degrades to greedy (never waiting) when the reader is the
+    bottleneck. Queue depth grows to ``group`` so groups CAN form.
+
+    ``overlapped=False`` (or ``[pipeline] overlapped = false``) runs
+    the exact same stages inline on the calling thread — the
+    synchronous reference path the smoke test compares shard bytes
+    against.
+
+    ``stats`` (a :class:`PipeStats`) is filled with the per-stage
+    breakdown; every run is also folded into the process totals at
+    ``/debug/vars`` under ``kind`` unless ``publish`` is False (the
+    file-encode path defers publication until writeback time is
+    folded in).
+    """
+    cfg = _CONFIG
+    if depth is None:
+        depth = cfg.depth
+    if overlapped is None:
+        overlapped = cfg.overlapped
+    st = stats if stats is not None else PipeStats()
+    grouping = encode_multi_fn is not None and group > 1
+    if grouping and controller is None and cfg.feedback:
+        controller = GroupController(group)
+    t_wall = time.perf_counter()
+    try:
+        if not overlapped:
+            n = _run_sync(batches, encode_fn, write_fn, recycle_fn, st)
+        else:
+            n = _run_overlapped(batches, encode_fn, write_fn, depth,
+                                encode_multi_fn if grouping else None,
+                                group, recycle_fn, st, controller)
+    finally:
+        st.wall_seconds = time.perf_counter() - t_wall
+        if publish:
+            publish_stats(st, kind=kind)
+    return n
+
+
+def _batch_nbytes(batch) -> int:
+    return getattr(batch, "nbytes", 0)
+
+
+def _run_sync(batches, encode_fn, write_fn, recycle_fn,
+              st: PipeStats) -> int:
+    """The synchronous reference path: same stages, one thread."""
+    n = 0
+    it = iter(batches)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            break
+        t1 = time.perf_counter()
+        st.read_seconds += t1 - t0
+        meta, batch = item
+        result = encode_fn(batch)
+        t2 = time.perf_counter()
+        st.dispatch_seconds += t2 - t1
+        result_np = np.asarray(result)
+        t3 = time.perf_counter()
+        st.sync_seconds += t3 - t2
+        write_fn(meta, batch, result_np)
+        if recycle_fn is not None:
+            recycle_fn(meta, batch)
+        st.write_seconds += time.perf_counter() - t3
+        st.batches += 1
+        st.groups += 1
+        st.max_group = max(st.max_group, 1)
+        st.bytes_in += _batch_nbytes(batch)
+        st.bytes_out += result_np.nbytes
+    return n or st.batches
+
+
+def _run_overlapped(batches, encode_fn, write_fn, depth,
+                    encode_multi_fn, group, recycle_fn,
+                    st: PipeStats,
+                    controller: Optional[GroupController]) -> int:
     if encode_multi_fn is not None and group > 1:
         depth = max(depth, group)
     read_q: queue.Queue = queue.Queue(maxsize=depth)
@@ -105,7 +500,19 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
 
     def reader():
         try:
-            for item in batches:
+            it = iter(batches)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                dt = time.perf_counter() - t0
+                st.read_seconds += dt
+                _stage_observe("pipe.read", dt,
+                               _batch_nbytes(item[1]))
+                if controller is not None:
+                    controller.note_read(dt)
                 if stop.is_set():
                     return
                 read_q.put(item)
@@ -120,15 +527,35 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
                 item = write_q.get()
                 if item is _END:
                     return
-                meta, batch, result = item
-                write_fn(meta, batch, np.asarray(result))
+                meta, batch, result, disp_share = item
+                t0 = time.perf_counter()
+                result_np = np.asarray(result)
+                t1 = time.perf_counter()
+                st.sync_seconds += t1 - t0
+                _stage_observe("pipe.compute", disp_share + (t1 - t0),
+                               result_np.nbytes)
+                write_fn(meta, batch, result_np)
+                if recycle_fn is not None:
+                    recycle_fn(meta, batch)
+                dt = time.perf_counter() - t1
+                st.write_seconds += dt
+                _stage_observe("pipe.write", dt)
+                st.batches += 1
+                st.bytes_in += _batch_nbytes(batch)
+                st.bytes_out += result_np.nbytes
         except BaseException as e:  # noqa: BLE001
             errors.append(e)
             stop.set()
             # Drain so the producer side never blocks on a full queue.
             while True:
-                if write_q.get() is _END:
+                item = write_q.get()
+                if item is _END:
                     return
+                if recycle_fn is not None:
+                    try:
+                        recycle_fn(item[0], item[1])
+                    except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                        pass
 
     rt = threading.Thread(target=reader, name="ec-pipe-read",
                           daemon=True)
@@ -144,37 +571,105 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
             if item is _END:
                 break
             if stop.is_set():
-                continue  # drain reader after writer failure
-            if encode_multi_fn is None or group <= 1:
+                # drain reader after writer failure; recycle so pooled
+                # readers blocked on acquire() can run to completion
+                if recycle_fn is not None:
+                    try:
+                        recycle_fn(item[0], item[1])
+                    except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                        pass
+                continue
+            if encode_multi_fn is None:
                 meta, batch = item
-                result = encode_fn(batch)
-                write_q.put((meta, batch, result))
+                t0 = time.perf_counter()
+                try:
+                    result = encode_fn(batch)
+                except BaseException as e:  # noqa: BLE001 — see below
+                    # compute failed: recycle the in-flight batch so a
+                    # pooled reader blocked on acquire() can drain, and
+                    # surface through the same PipelineError path as
+                    # reader/writer failures
+                    errors.append(e)
+                    stop.set()
+                    if recycle_fn is not None:
+                        try:
+                            recycle_fn(meta, batch)
+                        except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                            pass
+                    break
+                dt = time.perf_counter() - t0
+                st.dispatch_seconds += dt
+                st.groups += 1
+                st.max_group = max(st.max_group, 1)
+                write_q.put((meta, batch, result, dt))
                 n += 1
                 continue
-            # greedy group: whatever is already queued, up to `group`
+            # group drain: whatever is already queued, plus — when the
+            # controller's measured amortization justifies it — a
+            # bounded wait for the group to fill to the current target
+            target = min(group, controller.target()) if controller \
+                else group
             items = [item]
-            while len(items) < group:
+            while len(items) < target:
                 try:
                     nxt = read_q.get_nowait()
                 except queue.Empty:
-                    break
+                    wait = controller.wait_seconds() if controller \
+                        else 0.0
+                    if wait <= 0.0:
+                        if controller is not None:
+                            controller.note_starved()
+                        break
+                    try:
+                        nxt = read_q.get(timeout=wait)
+                    except queue.Empty:
+                        if controller is not None:
+                            controller.note_starved()
+                        break
                 if nxt is _END:
                     ended = True
                     break
                 items.append(nxt)
-            results = encode_multi_fn([b for _, b in items])
+            if controller is not None and len(items) >= target:
+                controller.note_supplied()
+            t0 = time.perf_counter()
+            try:
+                results = encode_multi_fn([b for _, b in items])
+            except BaseException as e:  # noqa: BLE001 — as single path
+                errors.append(e)
+                stop.set()
+                if recycle_fn is not None:
+                    for meta, batch in items:
+                        try:
+                            recycle_fn(meta, batch)
+                        except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                            pass
+                break
+            dt = time.perf_counter() - t0
+            st.dispatch_seconds += dt
+            st.groups += 1
+            st.max_group = max(st.max_group, len(items))
+            if controller is not None:
+                controller.note_dispatch(dt, len(items))
+            share = dt / len(items)
             for (meta, batch), result in zip(items, results):
-                write_q.put((meta, batch, result))
+                write_q.put((meta, batch, result, share))
             n += len(items)
     finally:
         write_q.put(_END)
         wt.join()
         stop.set()
-        # Unblock the reader if it is waiting on a full queue.
+        # Unblock the reader if it is waiting on a full queue, and
+        # recycle anything it had already materialized.
         try:
             while True:
-                read_q.get_nowait()
-        except queue.Empty:
+                item = read_q.get_nowait()
+                if item is not _END and recycle_fn is not None:
+                    try:
+                        recycle_fn(item[0], item[1])
+                    except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                        pass
+        except queue.Empty:  # seaweedlint: disable=SW301 — drained: empty queue IS the loop exit
             pass
         rt.join()
     if errors:

@@ -5,10 +5,20 @@ erasure_coding ec_encoder.go RebuildEcFiles does — find which .ec?? files
 exist, and if at least k survive, produce the missing ones. The decode
 matrix composition happens host-side (ops/rs_jax.py), so every missing
 shard — data or parity — comes out of a single device pass per chunk.
+
+Rebuild rides the same overlapped ingest plane as encode
+(pipe.py/writeback.py): survivor chunks are ``os.preadv``'d straight
+into pooled host buffers, reconstruction overlaps the next chunk's
+reads, and missing-shard chunks land at deterministic offsets in
+preallocated files via the positioned-write pool. Rebuilt bytes are
+fresh arrays (the D2H copy), so input buffers recycle as soon as a
+chunk's compute has synced — no writeback token needed.
 """
 
 from __future__ import annotations
 
+import os
+import time
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -16,10 +26,11 @@ import numpy as np
 
 from ..ops.rs_ref import TooFewShardsError
 from ..storage import ec_files
-from . import pipe
+from . import pipe, writeback
 from .scheme import DEFAULT_SCHEME, EcScheme
 
-#: Chunk of shard-file bytes processed per device call.
+#: Chunk of shard-file bytes processed per device call; the live input
+#: bound is ``[pipeline] batch_bytes / data_shards`` when unset here.
 DEFAULT_CHUNK_BYTES = 64 * 1024 * 1024
 
 
@@ -72,21 +83,38 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         from ..ops import rs_pallas
         align = max(rs_pallas.SEG_BYTES, rs_pallas.SWAR_SEG_BYTES)
         chunk_bytes = max(align, (grouped_total // k) // align * align)
-    ins = [open(ec_files.shard_path(base, i), "rb") for i in present]
-    outs = [open(ec_files.shard_path(base, i), "wb") for i in missing]
+
+    cfg = pipe.current()
+    depth_eff = max(cfg.depth, group)
+    pool = pipe.HostBufferPool(
+        max(1, k * min(chunk_bytes, size or 1)),
+        cfg.pool_buffers or max(4, depth_eff + 2))
+    in_fds = [os.open(ec_files.shard_path(base, i), os.O_RDONLY)
+              for i in present]
+    out_paths = [str(ec_files.shard_path(base, i)) for i in missing]
+    writer = writeback.WriterPool()
+    st = pipe.PipeStats()
 
     def chunks():
         pos = 0
         while pos < size:
             take = min(chunk_bytes, size - pos)
-            yield None, np.stack([
-                np.frombuffer(f.read(take), dtype=np.uint8) for f in ins])[
-                    None]
+            buf = pool.acquire()
+            view = buf[:k * take]
+            for s, fd in enumerate(in_fds):
+                _pread_into(fd, view[s * take:(s + 1) * take], pos)
+            yield (buf, pos), view.reshape(1, k, take)
             pos += take
 
-    def write(_meta, _chunk, rebuilt):
-        for row, f in zip(rebuilt[0], outs):
-            row.tofile(f)
+    def write(meta, _chunk, rebuilt):
+        # rebuilt (1, len(missing), take) is the fresh D2H array —
+        # positioned writes at the chunk offset, no buffer token.
+        _buf, pos = meta
+        for row, path in zip(rebuilt[0], out_paths):
+            writer.submit(path, pos, [row])
+
+    def recycle(meta, _chunk):
+        pool.release(meta[0])
 
     from ..util import tracing
 
@@ -98,18 +126,45 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         with tracing.span("ec.rebuild", base=str(base)) as sp:
             sp.n_bytes = size * len(missing)
             sp.tag(shards=",".join(str(i) for i in missing))
-            pipe.run_pipeline(chunks(), reconstruct, write,
-                              encode_multi_fn=reconstruct_multi,
-                              group=group)
+            t0 = time.perf_counter()
+            for path in out_paths:
+                writer.open_file(path, size)
+            try:
+                pipe.run_pipeline(chunks(), reconstruct, write,
+                                  encode_multi_fn=reconstruct_multi,
+                                  group=group, recycle_fn=recycle,
+                                  stats=st, publish=False)
+            except pipe.PipelineError:
+                writer.abort()
+                writer = None
+                raise
+            writer.close()
+            st.write_seconds += writer.busy_seconds
+            writer = None
+            st.wall_seconds = time.perf_counter() - t0
+            pipe.publish_stats(st, kind="ec.rebuild")
     finally:
-        for f in ins + outs:
-            f.close()
+        if writer is not None:
+            writer.abort()
+        for fd in in_fds:
+            os.close(fd)
     # Shard files changed under any reader holding cached post-decode
     # needles for this volume — tell every live chunk cache.
     from ..cache import invalidation as cache_invalidation
 
     cache_invalidation.base_invalidated(base, reason="ec-rebuild")
     return missing
+
+
+def _pread_into(fd: int, view: np.ndarray, offset: int) -> None:
+    mv = memoryview(view)
+    want, got = len(mv), 0
+    while got < want:
+        n = os.preadv(fd, [mv[got:]], offset + got)
+        if n <= 0:
+            raise EcRebuildError(
+                f"short read from survivor shard at {offset + got}")
+        got += n
 
 
 def _pick_reconstruct_fn(scheme: EcScheme, present, missing):

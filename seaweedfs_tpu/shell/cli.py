@@ -63,11 +63,17 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
 
+    from ..util import config as config_mod
+    conf = config_mod.load(args.config) if args.config else {}
+    if config_mod.lookup(conf, "pipeline") is not None:
+        # offline ec.encode/ec.rebuild honor [pipeline] tuning too —
+        # import only when configured (keeps bare shell startup lean)
+        from ..pipeline import pipe as pipe_mod
+        pipe_mod.configure_from(conf)
+
     if args.master:
         from . import fs_commands  # noqa: F401 — registers fs.* commands
-        from ..util import config as config_mod
         from ..util import tls as tls_mod
-        conf = config_mod.load(args.config) if args.config else {}
         secret = config_mod.lookup(conf, "jwt.signing.key", "")
         tls_mod.install_from_config(conf)
         env = ClusterEnv(master_url=args.master, filer_url=args.filer,

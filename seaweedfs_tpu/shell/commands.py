@@ -406,6 +406,38 @@ def cmd_cache_clear(env: CommandEnv, argv: list[str]) -> None:
     env.println(f"cache.clear: dropped {dropped} entries")
 
 
+@command("pipeline.status")
+def cmd_pipeline_status(env: CommandEnv, argv: list[str]) -> None:
+    """Overlapped-ingest-plane config + per-run stage breakdowns of
+    this process (docs/pipeline.md)."""
+    p = _parser("pipeline.status")
+    p.parse_args(argv)
+    from ..pipeline import pipe
+    cfg = pipe.current()
+    env.println(
+        f"pipeline.status depth={cfg.depth} "
+        f"batch_bytes={cfg.batch_bytes} "
+        f"grouped_batch_bytes={cfg.grouped_batch_bytes} "
+        f"group_cap={cfg.group_cap or 'env'} "
+        f"writers={cfg.writer_threads}x{cfg.writer_queue_depth} "
+        f"feedback={cfg.feedback} overlapped={cfg.overlapped} "
+        f"preallocate={cfg.preallocate}")
+    pay = pipe.debug_payload()
+    env.println(
+        f"  totals: runs={pay['runs']} batches={pay['batches']} "
+        f"in={pay['bytes_in']}B out={pay['bytes_out']}B "
+        f"read={pay['read_seconds']}s compute={pay['compute_seconds']}s "
+        f"write={pay['write_seconds']}s wall={pay['wall_seconds']}s")
+    for run in pay["recent"]:
+        env.println(
+            f"  {run['kind']}: {run['batches']} batches "
+            f"in {run['groups']} dispatches (max group "
+            f"{run['max_group']}) {run['bytes_in']}B "
+            f"read={run['read']}s compute={run['compute']}s "
+            f"write={run['write']}s wall={run['wall']}s "
+            f"{run.get('gibps', 0)} GiB/s")
+
+
 @command("trace.status")
 def cmd_trace_status(env: CommandEnv, argv: list[str]) -> None:
     """Tracing config + ring-buffer occupancy + per-stage span counts

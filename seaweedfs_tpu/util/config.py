@@ -83,6 +83,20 @@ failover_budget_seconds = 5.0    # cap on waiting out a master election
 failure_threshold = 5            # consecutive failures -> open
 cooldown_seconds = 5.0           # open -> half-open probe delay
 """,
+    "pipeline": """\
+# pipeline.toml — overlapped EC ingest plane (docs/pipeline.md).
+[pipeline]
+depth = 2                        # stage-queue depth (double buffering)
+batch_bytes = 268435456          # max input bytes per device batch
+grouped_batch_bytes = 67108864   # per-batch clamp while grouping
+group_cap = 0                    # max batches/dispatch; 0 = env default
+writer_threads = 4               # positioned shard-write pool width
+writer_queue_depth = 4           # pending writes per writer thread
+pool_buffers = 0                 # reusable host buffers; 0 = derive
+feedback = true                  # latency-fed group-size controller
+overlapped = true                # false = synchronous reference path
+preallocate = true               # size shard files up front
+""",
     "faults": """\
 # faults.toml — deterministic fault injection (docs/robustness.md).
 # Spec syntax: action[@probability][:param][#count], e.g.

@@ -28,6 +28,15 @@ except ImportError:  # non-unix: the /proc vitals still apply
 _START_TIME = time.time()
 
 
+def _pipeline_payload() -> dict:
+    # lazy: the EC pipeline (and its jax import chain) must not load
+    # just because a gateway served /debug/vars
+    mod = sys.modules.get("seaweedfs_tpu.pipeline.pipe")
+    if mod is None:
+        return {}
+    return mod.debug_payload()
+
+
 def _rss_bytes() -> Optional[int]:
     # /proc is authoritative on linux; ru_maxrss is a peak, not current
     try:
@@ -59,6 +68,7 @@ def payload(component: str, metrics: Optional[Metrics] = None,
         "slow_requests": tracing.slow_requests(),
         "breakers": retry.breakers_payload(),
         "faults": faults.debug_payload(),
+        "pipeline": _pipeline_payload(),
     }
     rss = _rss_bytes()
     if rss is not None:
