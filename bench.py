@@ -61,6 +61,7 @@ CACHE_TIMEOUT = 180      # chunk-cache zipfian stage (pure CPU, no jax)
 TRACE_TIMEOUT = 300      # tracing-overhead stage (CPU mini cluster)
 TELEMETRY_TIMEOUT = 300  # telemetry-overhead stage (CPU mini cluster)
 FAULT_TIMEOUT = 300      # fault-point-overhead stage (CPU mini cluster)
+PROFILE_TIMEOUT = 300    # profiler-overhead stage (CPU mini cluster)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -233,6 +234,12 @@ def parent() -> None:
     rc, out = _run(["--child-fault-overhead"], _scrubbed_env(),
                    FAULT_TIMEOUT)
     stage_platforms["fault"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Always-on continuous-profiler tax on the same path — same design.
+    rc, out = _run(["--child-profile-overhead"], _scrubbed_env(),
+                   PROFILE_TIMEOUT)
+    stage_platforms["profile"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1595,6 +1602,14 @@ if sys.argv[2] == "tracing":
     plane = tracing
 elif sys.argv[2] == "telemetry":
     plane = telemetry
+elif sys.argv[2] == "profiler":
+    # on = the always-on low-rate sampler thread (1 Hz default); the
+    # tax a request pays is GIL time stolen by the frame walk.
+    from seaweedfs_tpu.util import profiler as _profiler
+    class plane:
+        @staticmethod
+        def configure(enabled):
+            _profiler.configure(enabled=enabled, hz=1.0)
 else:  # "faults": on = armed-but-inert spec, so every fault point in
     # the read path pays the real armed cost (dict lookup miss) while
     # injecting nothing; off = the disarmed single-flag fast path.
@@ -1787,6 +1802,32 @@ def child_fault_overhead() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_profile_overhead() -> None:
+    """Continuous-profiler tax on the cached-read path
+    (docs/observability.md).
+
+    Same paired-block harness as the trace/telemetry/fault stages; the
+    stdin toggle flips ``profiler.configure(enabled=...)`` on the
+    server process, so the difference is exactly the always-on
+    sampler's cost: one ``sys._current_frames()`` walk + collapsed-
+    stack fold per second, amortized across the requests in flight.
+    Acceptance (ISSUE 7): overhead < 5%."""
+    t_off, t_on = _measure_plane_overhead("profiler")
+    overhead = (t_on - t_off) / t_off
+    res = {
+        "profile_overhead_pct": round(overhead * 100, 2),
+        "profile_read_us_off": round(t_off * 1e6, 1),
+        "profile_read_us_on": round(t_on * 1e6, 1),
+        "profile_overhead_ok": bool(overhead < 0.05),
+    }
+    log(f"profile stage: cached read {res['profile_read_us_off']}us "
+        f"off / {res['profile_read_us_on']}us on -> "
+        f"{res['profile_overhead_pct']}% overhead "
+        f"({'OK' if res['profile_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -1812,5 +1853,8 @@ if __name__ == "__main__":
     elif ("--child-fault-overhead" in sys.argv
           or "--fault-overhead" in sys.argv):
         child_fault_overhead()
+    elif ("--child-profile-overhead" in sys.argv
+          or "--profile-overhead" in sys.argv):
+        child_profile_overhead()
     else:
         parent()

@@ -34,5 +34,18 @@ if [ "$rc" -ne 0 ]; then
     echo "lint_gate: pipeline_smoke failed (exit $rc) — the" \
          "overlapped encode path diverged from the synchronous" \
          "reference; see scripts/pipeline_smoke.sh" >&2
+    exit "$rc"
+fi
+
+# Observability-plane smoke (docs/observability.md): SLO burn-rate
+# math, the burn-rate gauges' exposition, a profiler burst, and trace
+# stitching — in-process, a few seconds.
+bash scripts/slo_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: slo_smoke failed (exit $rc) — the SLO engine," \
+         "profiler, or trace collector regressed; see" \
+         "scripts/slo_smoke.sh" >&2
 fi
 exit "$rc"

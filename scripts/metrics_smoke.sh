@@ -7,7 +7,10 @@
 #     /debug/traces rings (the ISSUE's end-to-end acceptance bar), or
 #   - the read's per-volume hot stats are not visible at the master's
 #     /cluster/telemetry within two heartbeats, or
-#   - any server's /debug/vars is missing or not well-formed JSON.
+#   - any server's /debug/vars is missing or not well-formed JSON, or
+#   - the cluster observability plane is dark: /cluster/traces or
+#     /cluster/slo missing, seaweed_slo_burn_rate absent from the
+#     master's exposition, or /debug/profile returning no stacks.
 #
 #   bash scripts/metrics_smoke.sh [portBase] [workdir]
 set -euo pipefail
@@ -25,8 +28,26 @@ F=127.0.0.1:$((PORT + 200))
 say() { printf '\n== %s ==\n' "$*"; }
 
 mkdir -p "$WORK/data"
+# SLO + profiler config so the observability plane is live end to end
+# (docs/observability.md): a deliberately strict read target makes the
+# burn-rate gauges non-trivial, and the always-on profiler feeds
+# hot_stacks onto the heartbeat.
+cat > "$WORK/smoke.toml" <<'TOML'
+[slo]
+enabled = true
+read_p99_ms = 50.0
+availability = 0.999
+evaluation_interval_seconds = 1.0
+
+[profiler]
+enabled = true
+hz = 19.0
+
+[tracing]
+push_threshold_seconds = 0.5
+TOML
 $W cluster -dir "$WORK/data" -volumes 1 -filer -portBase "$PORT" \
-  -pulseSeconds 1 > "$WORK/cluster.log" 2>&1 &
+  -pulseSeconds 1 -config "$WORK/smoke.toml" > "$WORK/cluster.log" 2>&1 &
 CPID=$!
 trap 'kill $CPID 2>/dev/null; sleep 1' EXIT
 for _ in $(seq 1 120); do
@@ -135,6 +156,75 @@ if missing:
     sys.exit(f"FAIL: master /metrics missing {missing}")
 print("master telemetry gauges present:", ", ".join(want))
 EOF
+
+say "/cluster/traces and /cluster/slo must serve the plane's JSON"
+curl -sf "http://$M/cluster/traces" -o "$WORK/ctraces.json" ||
+  { echo "FAIL: /cluster/traces unreachable"; exit 1; }
+curl -sf "http://$M/cluster/slo" -o "$WORK/slo.json" ||
+  { echo "FAIL: /cluster/slo unreachable"; exit 1; }
+python - "$WORK/ctraces.json" "$WORK/slo.json" <<'EOF'
+import json, sys
+tr = json.load(open(sys.argv[1], encoding="utf-8"))
+for key in ("ring_size", "count", "ingested", "traces"):
+    if key not in tr:
+        sys.exit(f"FAIL: /cluster/traces missing {key!r}")
+slo = json.load(open(sys.argv[2], encoding="utf-8"))
+if not slo.get("enabled"):
+    sys.exit("FAIL: /cluster/slo not enabled despite [slo] config")
+objs = slo.get("objectives", {})
+for want in ("read_p99_ms", "availability"):
+    if want not in objs:
+        sys.exit(f"FAIL: /cluster/slo missing objective {want!r}")
+    if objs[want]["state"] not in ("ok", "warn", "page"):
+        sys.exit(f"FAIL: bad slo state {objs[want]['state']!r}")
+print(f"/cluster/traces: ring={tr['ring_size']} "
+      f"ingested={tr['ingested']}; /cluster/slo objectives: "
+      + ", ".join(f"{k}={v['state']}" for k, v in objs.items()))
+EOF
+
+say "seaweed_slo_burn_rate must render as valid exposition"
+curl -sf "http://$M/metrics" -o "$WORK/metrics.txt"
+python - "$WORK/metrics.txt" <<'EOF'
+import sys
+sys.path.insert(0, "tests")
+from conftest import parse_exposition
+fams = parse_exposition(open(sys.argv[1], encoding="utf-8").read())
+rows = fams.get("seaweed_slo_burn_rate", [])
+windows = {lb.get("window") for lb, _ in rows}
+slos = {lb.get("slo") for lb, _ in rows}
+if not {"5m", "1h", "6h"} <= windows or "read_p99_ms" not in slos:
+    sys.exit(f"FAIL: seaweed_slo_burn_rate incomplete: "
+             f"slos={sorted(slos)} windows={sorted(windows)}")
+print(f"seaweed_slo_burn_rate: {len(rows)} series "
+      f"(slos {sorted(slos)}, windows {sorted(windows)})")
+EOF
+
+say "/debug/profile must return collapsed stacks on every server"
+for URL in "$M" "$V" "$F"; do
+  curl -sf "http://$URL/debug/profile?seconds=0.3" \
+    -o "$WORK/profile.txt" ||
+    { echo "FAIL: $URL/debug/profile unreachable"; exit 1; }
+  python - "$URL" "$WORK/profile.txt" <<'EOF'
+import sys
+url, path = sys.argv[1], sys.argv[2]
+lines = [ln for ln in open(path, encoding="utf-8").read().splitlines()
+         if ln.strip()]
+if not lines:
+    sys.exit(f"FAIL: {url}/debug/profile returned no stacks")
+for ln in lines:
+    stack, _, count = ln.rpartition(" ")
+    if not stack or not count.isdigit():
+        sys.exit(f"FAIL: {url}/debug/profile bad line: {ln!r}")
+print(f"{url}/debug/profile: {len(lines)} collapsed stacks")
+EOF
+done
+# ... and the master can proxy a profile of the volume server
+curl -sf "http://$M/cluster/profile?node=$V&seconds=0.3" \
+  -o "$WORK/profile.txt" ||
+  { echo "FAIL: /cluster/profile proxy failed"; exit 1; }
+[ -s "$WORK/profile.txt" ] ||
+  { echo "FAIL: /cluster/profile proxy returned empty body"; exit 1; }
+echo "/cluster/profile?node=$V: OK"
 
 say "/debug/vars must serve well-formed JSON on every server"
 for URL in "$M" "$V" "$F"; do

@@ -1,0 +1,114 @@
+#!/bin/bash
+# Observability-plane smoke for the CI gate (docs/observability.md):
+# in-process, no servers, a few seconds. Fails when
+#   - the SLO engine does not page on traffic that burns the error
+#     budget at ~100x (or pages on clearly healthy traffic),
+#   - seaweed_slo_burn_rate does not render as parseable exposition,
+#   - a profiler burst over a busy thread returns no collapsed stacks,
+#   - the trace collector cannot stitch two bundles of one trace.
+#
+#   bash scripts/slo_smoke.sh
+set -u
+cd "$(dirname "$0")/.." || exit 2
+export PYTHONPATH=$PWD
+
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+import threading
+
+sys.path.insert(0, "tests")
+from conftest import parse_exposition
+
+from seaweedfs_tpu.cluster.telemetry import SloEngine
+from seaweedfs_tpu.util import profiler, tracing
+from seaweedfs_tpu.util.stats import Digest
+
+
+class _Telemetry:
+    """One degraded interval: every read 400 ms, 5% hard errors."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def cluster_counters(self):
+        self.calls += 1
+        return ({"ops": 0, "errors": 0} if self.calls == 1
+                else {"ops": 1000, "errors": 50})
+
+    def digests_since(self, ts, read=True):
+        if not read:
+            return None
+        d = Digest()
+        for _ in range(64):
+            d.add(0.4)
+        return d
+
+
+now = [0.0]
+eng = SloEngine(_Telemetry(), clock=lambda: now[0])
+eng.configure({"slo": {"enabled": True, "read_p99_ms": 100.0,
+                       "availability": 0.999}})
+eng.evaluate()
+now[0] += 1.0
+doc = eng.evaluate()
+for name in ("read_p99_ms", "availability"):
+    state = doc["objectives"][name]["state"]
+    if state != "page":
+        sys.exit(f"FAIL: {name} is {state!r} on 100x-burn traffic")
+fams = parse_exposition(eng.metrics.render())
+rows = fams.get("seaweed_slo_burn_rate", [])
+fast = [v for lb, v in rows
+        if lb == {"slo": "read_p99_ms", "window": "5m"}]
+if not fast or fast[0] < 14.4:
+    sys.exit(f"FAIL: seaweed_slo_burn_rate 5m gauge wrong: {rows}")
+print(f"slo engine: both objectives page, burn(5m)={fast[0]:.0f}x, "
+      f"{len(rows)} gauge series parse")
+
+# a healthy engine must NOT page
+calm = SloEngine(_Telemetry(), clock=lambda: now[0])
+calm.telemetry.cluster_counters = lambda: {"ops": 1000, "errors": 0}
+calm.configure({"slo": {"enabled": True, "availability": 0.999}})
+calm.evaluate()
+now[0] += 1.0
+st = calm.evaluate()["objectives"]["availability"]["state"]
+if st != "ok":
+    sys.exit(f"FAIL: clean traffic is {st!r}, want ok")
+print("slo engine: clean traffic stays ok")
+
+# profiler burst over a busy thread
+stop = threading.Event()
+t = threading.Thread(
+    target=lambda: [sum(i * i for i in range(500))
+                    for _ in iter(stop.is_set, True)])
+t.start()
+try:
+    text = profiler.profile(seconds=0.3, hz=97)
+finally:
+    stop.set()
+    t.join()
+lines = [ln for ln in text.splitlines() if ln.strip()]
+if not lines:
+    sys.exit("FAIL: profiler burst returned no stacks")
+for ln in lines:
+    stack, _, count = ln.rpartition(" ")
+    if not stack or not count.isdigit():
+        sys.exit(f"FAIL: bad collapsed-stack line: {ln!r}")
+print(f"profiler: burst captured {len(lines)} collapsed stacks")
+
+# trace collector stitches two bundles of one trace
+c = tracing.TraceCollector(ring_size=8)
+for comp, port, parent in (("volume", 81, "up"), ("filer", 88, "")):
+    c.ingest({"node": f"127.0.0.1:{port}", "component": comp,
+              "reason": "slow",
+              "bundle": {"trace_id": "smoke", "name": f"{comp}.GET",
+                         "start": 1.0, "duration_seconds": 0.5,
+                         "remote_parent": parent, "status": "ok",
+                         "spans": [{"span_id": f"{comp}-s",
+                                    "name": f"{comp}.GET",
+                                    "duration_seconds": 0.5}]}})
+tr = c.traces()
+if len(tr) != 1 or tr[0]["span_count"] != 2 or not tr[0]["has_root"]:
+    sys.exit(f"FAIL: collector did not stitch: {tr}")
+print("trace collector: 2 bundles stitched into 1 trace")
+print("SLO/PROFILE SMOKE PASSED")
+EOF

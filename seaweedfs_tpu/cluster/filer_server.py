@@ -27,6 +27,7 @@ from ..filer.stores import MemoryStore, SqliteStore
 from ..pb import filer_pb2
 from ..util import faults as faults_mod
 from ..util import glog
+from ..util import profiler
 from ..util import retry
 from ..util import tracing
 from ..util import varz
@@ -132,6 +133,10 @@ class FilerServer:
                              daemon=True, name=f"filer-http-{self.port}")
         t.start()
         self._threads.append(t)
+        if self.master_url:
+            # Slow/errored filer roots join the master's stitched view.
+            tracing.configure_push(self.master_url, node=self.url,
+                                   component="filer")
         self._load_path_conf()
         t = threading.Thread(target=self._follow_path_conf,
                              daemon=True,
@@ -371,6 +376,13 @@ def _make_http_handler(fs: FilerServer):
                 self._send(200, json.dumps(tracing.debug_payload(
                     int(q["limit"]) if "limit" in q else None)).encode())
                 return
+            if u.path == "/debug/profile":
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                self._send(200, profiler.profile(
+                    float(q.get("seconds", 2.0)),
+                    hz=float(q.get("hz", profiler.DEFAULT_BURST_HZ))
+                ).encode(), "text/plain; charset=utf-8")
+                return
             if u.path == "/debug/vars":
                 self._send(200, json.dumps(
                     varz.payload("filer", fs.metrics)).encode())
@@ -599,6 +611,8 @@ def main(argv: list[str]) -> int:
     tracing.configure_from(conf)
     retry.configure_from(conf)
     faults_mod.configure_from(conf)
+    profiler.configure_from(conf)
+    profiler.ensure_started()
     store = SqliteStore(args.db) if args.db else MemoryStore()
     filer = Filer(store)
     server = FilerServer(filer, ip=args.ip, port=args.port,

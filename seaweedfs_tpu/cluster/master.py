@@ -32,6 +32,7 @@ from ..storage.types import FileId
 from ..util import config as config_mod
 from ..util import faults as faults_mod
 from ..util import glog
+from ..util import profiler
 from ..util import retry
 from ..util import security
 from ..util import tls as tls_mod
@@ -41,6 +42,7 @@ from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import ha as ha_mod
 from .ha import NotLeaderError
 from .sequence import MemorySequencer
+from .telemetry import SloEngine
 from .topology import Topology, TopologyError, VolumeInfo
 
 
@@ -62,7 +64,8 @@ class MasterServer:
                  meta_dir: Optional[str] = None,
                  election_timeout: tuple[float, float] = (0.45, 0.9),
                  metrics_address: str = "",
-                 metrics_interval_seconds: float = 15.0):
+                 metrics_interval_seconds: float = 15.0,
+                 trace_ring_size: int = 256):
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
@@ -105,6 +108,15 @@ class MasterServer:
         #: -metrics.address flow).
         self.metrics_address = metrics_address
         self.metrics_interval_seconds = metrics_interval_seconds
+        #: Cluster-wide stores for the observability plane: stitched
+        #: tail-sampled traces (servers POST /cluster/traces) and the
+        #: SLO burn-rate engine over the telemetry registry. Both live
+        #: on every master but only the leader's fill up — volume
+        #: servers heartbeat (and push traces to) the leader, so the
+        #: /cluster/* read paths leader-proxy like /cluster/telemetry.
+        self.trace_collector = tracing.TraceCollector(
+            ring_size=trace_ring_size)
+        self.slo = SloEngine(self.topology.telemetry)
         self._pusher = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
@@ -168,6 +180,11 @@ class MasterServer:
                                         name=f"master-reaper-{self.port}")
         self._reaper.start()
         self.ha.start()
+        self.slo.start()
+        # The master's own slow/errored roots go straight into the
+        # in-process collector — no HTTP round trip to self.
+        tracing.configure_push(self.trace_collector.ingest,
+                               node=self.url, component="master")
         if self.metrics_address:
             from ..util.stats import MetricsPusher
             self._pusher = MetricsPusher(
@@ -180,6 +197,7 @@ class MasterServer:
     def stop(self) -> None:
         self._stop.set()
         self.ha.stop()
+        self.slo.stop()
         if self._pusher is not None:
             self._pusher.stop()
         if self._grpc_server:
@@ -610,6 +628,13 @@ def _make_http_handler(ms: MasterServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _text(self, body: bytes, code: int = 200) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def _proxy_to_leader(self) -> bool:
             """Forward this request to the current leader (follower
             masters stay useful to dumb HTTP clients), preserving the
@@ -687,6 +712,7 @@ def _make_http_handler(ms: MasterServer):
                                 "Topology": ms.topology.to_map()})
                 elif u.path == "/metrics":
                     body = (ms.metrics.render()
+                            + ms.slo.metrics.render()
                             + tracing.METRICS.render()
                             + retry.METRICS.render()).encode()
                     self.send_response(200)
@@ -706,6 +732,49 @@ def _make_http_handler(ms: MasterServer):
                     self._json(ms.topology.telemetry.to_map(
                         nodes_last_seen=last_seen,
                         pulse_seconds=ms.topology.pulse_seconds))
+                elif u.path == "/cluster/traces":
+                    # Tail-sampled traces land on the leader (that is
+                    # where servers push), so read from there.
+                    if self._proxy_to_leader():
+                        return
+                    self._json(ms.trace_collector.payload(
+                        int(q["limit"]) if q.get("limit") else None))
+                elif u.path == "/cluster/slo":
+                    if self._proxy_to_leader():
+                        return
+                    # Evaluate on demand: the tick is idempotent and
+                    # this keeps curl output fresh even with a long
+                    # background interval.
+                    self._json(ms.slo.evaluate())
+                elif u.path == "/cluster/profile":
+                    # Master-side proxy to any node's /debug/profile so
+                    # operators profile the fleet from one place.
+                    node = q.get("node", "")
+                    if not node:
+                        self._json(
+                            {"error": "node query parameter required"},
+                            400)
+                        return
+                    seconds = min(float(q.get("seconds", 2.0)),
+                                  profiler.MAX_SECONDS)
+                    try:
+                        r = retry.http_request(
+                            f"http://{node}/debug/profile"
+                            f"?seconds={seconds}",
+                            point="master.profile_proxy",
+                            timeout=seconds + 30.0, use_breaker=False)
+                    except Exception as e:  # noqa: BLE001
+                        self._json({"error":
+                                    f"node {node} unreachable: {e}"},
+                                   502)
+                        return
+                    self._text(r.data)
+                elif u.path == "/debug/profile":
+                    self._text(profiler.profile(
+                        float(q.get("seconds", 2.0)),
+                        hz=float(q.get("hz",
+                                       profiler.DEFAULT_BURST_HZ))
+                    ).encode())
                 elif u.path == "/debug/traces":
                     self._json(tracing.debug_payload(
                         int(q.get("limit", -1))
@@ -714,7 +783,11 @@ def _make_http_handler(ms: MasterServer):
                     self._json(varz.payload(
                         "master", ms.metrics,
                         extra={"is_leader": ms.is_leader,
-                               "nodes": len(ms.topology.nodes)}))
+                               "nodes": len(ms.topology.nodes),
+                               "slo_state": ms.slo.worst_state(),
+                               "slo_alerts": list(ms.slo.alerts),
+                               "trace_collector":
+                                   ms.trace_collector.payload(0)}))
                 else:
                     self._json({"error": "not found"}, 404)
             except NotLeaderError as e:
@@ -747,6 +820,18 @@ def _make_http_handler(ms: MasterServer):
                 except PermissionError as e:
                     self._json({"error": str(e)}, 409)
                 except ValueError as e:
+                    self._json({"error": str(e)}, 400)
+            elif u.path == "/cluster/traces":
+                # Tail-sample sink: servers push slow/errored root
+                # bundles here (tracing._push_loop).
+                if self._proxy_to_leader():
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    ms.trace_collector.ingest(payload)
+                    self._json({"ok": True})
+                except (ValueError, OSError) as e:
                     self._json({"error": str(e)}, 400)
             elif u.path == "/vol/grow":
                 if self._proxy_to_leader():
@@ -793,6 +878,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     tracing.configure_from(conf)
     retry.configure_from(conf)
     faults_mod.configure_from(conf)
+    profiler.configure_from(conf)
+    profiler.ensure_started()
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,
                       default_replication=args.defaultReplication,
@@ -800,7 +887,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                       peers=[x for x in args.peers.split(",") if x],
                       meta_dir=args.mdir or None,
                       metrics_address=args.metricsAddress,
-                      metrics_interval_seconds=args.metricsIntervalSeconds)
+                      metrics_interval_seconds=args.metricsIntervalSeconds,
+                      trace_ring_size=int(config_mod.lookup(
+                          conf, "tracing.collector_ring_size", 256)))
+    if config_mod.lookup(conf, "slo") is not None:
+        ms.slo.configure(conf)
     ms.start()
     try:
         while True:

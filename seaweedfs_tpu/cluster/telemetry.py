@@ -32,6 +32,7 @@ from collections import deque
 from typing import Iterable, Optional
 
 from ..pb import master_pb2
+from ..util import glog, profiler
 from ..util.stats import Digest, Metrics
 
 _ENABLED = True
@@ -173,6 +174,12 @@ class TelemetryCollector:
                 m.read_latency.CopyFrom(rd.to_proto())
             if wd.count:
                 m.write_latency.CopyFrom(wd.to_proto())
+        # The always-on profiler's hottest stacks ride along, so the
+        # master's heatmap can say what code is hot, not just which
+        # volume (a few hundred bytes per heartbeat at most).
+        if profiler.enabled():
+            for stack, samples in profiler.hot_stacks():
+                snap.hot_stacks.add(stack=stack, samples=samples)
         return snap
 
     def to_map(self) -> dict:
@@ -220,12 +227,14 @@ class _VolAgg:
 
 
 class _NodeAgg:
-    __slots__ = ("volumes", "last_ingest", "snapshots")
+    __slots__ = ("volumes", "last_ingest", "snapshots", "hot_stacks")
 
     def __init__(self):
         self.volumes: dict[int, _VolAgg] = {}
         self.last_ingest = 0.0
         self.snapshots = 0
+        #: latest heartbeat's profiler top-k: [(collapsed_stack, n)]
+        self.hot_stacks: list[tuple[str, int]] = []
 
 
 class ClusterTelemetry:
@@ -262,6 +271,9 @@ class ClusterTelemetry:
             alpha = 1.0 - 0.5 ** (dt / self.halflife)
             node.last_ingest = now
             node.snapshots += 1
+            if snap.hot_stacks:
+                node.hot_stacks = [(hs.stack, int(hs.samples))
+                                   for hs in snap.hot_stacks]
             seen = set()
             for v in snap.volumes:
                 seen.add(v.volume_id)
@@ -412,6 +424,46 @@ class ClusterTelemetry:
         v = d.quantile(q)
         return None if math.isnan(v) else v
 
+    def cluster_counters(self) -> dict:
+        """Cluster-wide cumulative op/error totals (the availability
+        SLO diffs consecutive reads of this)."""
+        ops = errors = 0
+        with self._lock:
+            for node in self._nodes.values():
+                for agg in node.volumes.values():
+                    ops += agg.cum["read_ops"] + agg.cum["write_ops"]
+                    errors += agg.cum["errors"]
+        return {"ops": ops, "errors": errors}
+
+    def digests_since(self, ts: float,
+                      read: bool = True) -> Optional[Digest]:
+        """Merge every latency digest window ingested after ``ts``
+        across all nodes — the per-evaluation-interval sample set the
+        latency SLOs consume (each window is counted once as long as
+        callers advance ``ts``)."""
+        merged: Optional[Digest] = None
+        with self._lock:
+            for node in self._nodes.values():
+                for agg in node.volumes.values():
+                    for wts, rd, wd in agg.windows:
+                        if wts <= ts:
+                            continue
+                        d = rd if read else wd
+                        if d is None:
+                            continue
+                        if merged is None:
+                            merged = Digest(DIGEST_CENTROIDS)
+                        merged.merge(d)
+        return merged
+
+    def node_hot_stacks(self) -> dict:
+        """node url -> latest heartbeat hot stacks."""
+        with self._lock:
+            return {url: [{"stack": s, "samples": n}
+                          for s, n in node.hot_stacks]
+                    for url, node in self._nodes.items()
+                    if node.hot_stacks}
+
     def cluster_median_p99(self, read: bool = True) -> Optional[float]:
         with self._lock:
             urls = list(self._nodes)
@@ -495,6 +547,7 @@ class ClusterTelemetry:
                 node = self._nodes.get(url)
                 snapshots = node.snapshots if node else 0
                 last_ingest = node.last_ingest if node else 0.0
+                hot = list(node.hot_stacks) if node else []
             totals = {"read_ops_per_second": 0.0,
                       "write_ops_per_second": 0.0,
                       "errors_per_second": 0.0}
@@ -508,6 +561,9 @@ class ClusterTelemetry:
             p99 = self.node_quantile(url, 0.99)
             if p99 is not None:
                 entry["read_p99_seconds"] = p99
+            if hot:
+                entry["hot_stacks"] = [{"stack": s, "samples": n}
+                                       for s, n in hot]
             if url in nodes_last_seen:
                 entry["health"] = self.health(
                     url, nodes_last_seen[url], pulse_seconds)
@@ -519,3 +575,284 @@ class ClusterTelemetry:
         if median is not None:
             out["cluster_median_read_p99_seconds"] = median
         return out
+
+
+# --------------------------------------------------------------------------
+# master side: SLO burn-rate engine
+# --------------------------------------------------------------------------
+
+#: Latency objectives budget 1% of ops over the target ("p99" in the
+#: objective name literally means 99% of ops must beat the target).
+_LATENCY_BUDGET = 0.01
+
+
+def _fmt_window(seconds: float) -> str:
+    if seconds < 3600:
+        return "%gm" % (seconds / 60.0)
+    return "%gh" % (seconds / 3600.0)
+
+
+class _Objective:
+    __slots__ = ("name", "kind", "target", "budget", "read")
+
+    def __init__(self, name: str, kind: str, target: float,
+                 budget: float, read: bool = True):
+        self.name = name
+        self.kind = kind          # "latency" | "availability"
+        self.target = target      # seconds | min ok-fraction
+        self.budget = budget      # allowed bad-event fraction
+        self.read = read
+
+
+class SloEngine:
+    """Declarative SLOs evaluated against the telemetry registry with
+    SRE-style multi-window burn rates.
+
+    Each evaluation tick turns the interval's telemetry into (bad,
+    total) event counts per objective — for latency objectives, bad is
+    the digest mass above the target (``Digest.cdf``); for
+    availability, the error-counter delta — and appends them to a
+    per-objective history ring. A window's **burn rate** is then
+
+        (bad/total over the window) / error budget
+
+    i.e. "how many times faster than sustainable is the budget
+    burning". State per objective: ``page`` when BOTH fast windows
+    (default 5m and 1h) burn above ``fast_burn_threshold`` (the
+    short window makes the alert reactive, the long one keeps a brief
+    blip from paging), ``warn`` when the slow window (default 6h)
+    burns above ``slow_burn_threshold``, else ``ok``. Transitions land
+    in a bounded alert ring surfaced by ``/debug/vars`` and
+    ``/cluster/slo``; every (objective, window) pair exports a
+    ``seaweed_slo_burn_rate`` gauge.
+    """
+
+    def __init__(self, telemetry: ClusterTelemetry, clock=time.time):
+        self.telemetry = telemetry
+        self.clock = clock
+        #: Own registry, ``seaweed_`` namespace — the master appends
+        #: its render to /metrics next to the trace/retry families.
+        self.metrics = Metrics(namespace="seaweed")
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.eval_interval = 5.0
+        self.fast_burn_threshold = 14.4
+        self.slow_burn_threshold = 6.0
+        self.fast_window = 300.0
+        self.fast_long_window = 3600.0
+        self.slow_window = 21600.0
+        self._objectives: list[_Objective] = []
+        #: name -> deque[(ts, bad, total)], pruned past slow_window
+        self._history: dict[str, deque] = {}
+        self._state: dict[str, str] = {}
+        self._last_counters: Optional[dict] = None
+        self._last_digest_ts = 0.0
+        self.alerts: deque = deque(maxlen=64)
+        self.evaluations = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------- configuration ----------------
+
+    def configure(self, conf: Optional[dict]) -> "SloEngine":
+        """Apply a loaded config dict's ``[slo]`` section (also accepts
+        the section itself). Rebuilds the objective list; histories of
+        surviving objectives are kept."""
+        s = conf or {}
+        if isinstance(s.get("slo"), dict):
+            s = s["slo"]
+        with self._lock:
+            self.enabled = bool(s.get("enabled", self.enabled))
+            self.eval_interval = float(
+                s.get("evaluation_interval_seconds", self.eval_interval))
+            self.fast_burn_threshold = float(
+                s.get("fast_burn_threshold", self.fast_burn_threshold))
+            self.slow_burn_threshold = float(
+                s.get("slow_burn_threshold", self.slow_burn_threshold))
+            self.fast_window = float(
+                s.get("fast_window_seconds", self.fast_window))
+            self.fast_long_window = float(
+                s.get("fast_long_window_seconds", self.fast_long_window))
+            self.slow_window = float(
+                s.get("slow_window_seconds", self.slow_window))
+            objectives = []
+            ms = float(s.get("read_p99_ms", 0.0) or 0.0)
+            if ms > 0:
+                objectives.append(_Objective(
+                    "read_p99_ms", "latency", ms / 1e3,
+                    _LATENCY_BUDGET, read=True))
+            ms = float(s.get("write_p99_ms", 0.0) or 0.0)
+            if ms > 0:
+                objectives.append(_Objective(
+                    "write_p99_ms", "latency", ms / 1e3,
+                    _LATENCY_BUDGET, read=False))
+            avail = float(s.get("availability", 0.0) or 0.0)
+            if avail > 0:
+                if not 0 < avail < 1:
+                    raise ValueError(
+                        f"[slo] availability must be in (0, 1): {avail}")
+                objectives.append(_Objective(
+                    "availability", "availability", avail, 1.0 - avail))
+            self._objectives = objectives
+            names = {o.name for o in objectives}
+            for name in names:
+                self._history.setdefault(name, deque())
+                self._state.setdefault(name, "ok")
+            for stale in set(self._history) - names:
+                del self._history[stale]
+                del self._state[stale]
+        return self
+
+    # ---------------- evaluation ----------------
+
+    def _burn(self, name: str, window: float, now: float) -> float:
+        bad = total = 0.0
+        for ts, b, t in self._history[name]:
+            if now - ts <= window:
+                bad += b
+                total += t
+        if total <= 0:
+            return 0.0
+        budget = next(o.budget for o in self._objectives
+                      if o.name == name)
+        return (bad / total) / max(budget, 1e-9)
+
+    def evaluate(self) -> dict:
+        """One tick: sample the telemetry registry, update burn rates,
+        gauges, and alert states. Safe to call on demand (tests, the
+        lazy /cluster/slo path) — the interval deltas self-correct."""
+        now = self.clock()
+        with self._lock:
+            if not self.enabled or not self._objectives:
+                return self.payload_locked(now)
+            self.evaluations += 1
+            counters = self.telemetry.cluster_counters()
+            prev, self._last_counters = self._last_counters, counters
+            read_d = self.telemetry.digests_since(self._last_digest_ts,
+                                                  read=True)
+            write_d = self.telemetry.digests_since(self._last_digest_ts,
+                                                   read=False)
+            self._last_digest_ts = now
+            for o in self._objectives:
+                if o.kind == "availability":
+                    if prev is None:
+                        continue
+                    total = max(0, counters["ops"] - prev["ops"])
+                    bad = min(total, max(
+                        0, counters["errors"] - prev["errors"]))
+                else:
+                    d = read_d if o.read else write_d
+                    if d is None or not d.count:
+                        continue
+                    frac_ok = d.cdf(o.target)
+                    if math.isnan(frac_ok):
+                        continue
+                    total = d.count
+                    bad = (1.0 - frac_ok) * total
+                hist = self._history[o.name]
+                hist.append((now, float(bad), float(total)))
+                while hist and now - hist[0][0] > self.slow_window:
+                    hist.popleft()
+            for o in self._objectives:
+                burns = {
+                    _fmt_window(self.fast_window):
+                        self._burn(o.name, self.fast_window, now),
+                    _fmt_window(self.fast_long_window):
+                        self._burn(o.name, self.fast_long_window, now),
+                    _fmt_window(self.slow_window):
+                        self._burn(o.name, self.slow_window, now),
+                }
+                for win, rate in burns.items():
+                    self.metrics.gauge("slo_burn_rate", slo=o.name,
+                                       window=win).set(rate)
+                fast, fast_long, slow = burns.values()
+                if (fast > self.fast_burn_threshold
+                        and fast_long > self.fast_burn_threshold):
+                    state = "page"
+                elif slow > self.slow_burn_threshold:
+                    state = "warn"
+                else:
+                    state = "ok"
+                if state != self._state[o.name]:
+                    self.alerts.append({
+                        "ts": now, "slo": o.name,
+                        "from": self._state[o.name], "to": state,
+                        "burn_rates": {w: round(r, 2)
+                                       for w, r in burns.items()},
+                    })
+                    self._state[o.name] = state
+            return self.payload_locked(now)
+
+    # ---------------- views ----------------
+
+    def payload_locked(self, now: Optional[float] = None) -> dict:
+        """/cluster/slo JSON; caller holds no lock requirement — only
+        reads coherent snapshots of the per-objective rings."""
+        now = self.clock() if now is None else now
+        objectives = {}
+        for o in self._objectives:
+            hist = self._history.get(o.name, ())
+            bad = sum(b for _, b, _ in hist)
+            total = sum(t for _, _, t in hist)
+            objectives[o.name] = {
+                "kind": o.kind,
+                "target": (o.target if o.kind == "availability"
+                           else o.target * 1e3),
+                "unit": "fraction" if o.kind == "availability" else "ms",
+                "error_budget": o.budget,
+                "state": self._state.get(o.name, "ok"),
+                "bad_events": round(bad, 2),
+                "total_events": round(total, 2),
+                "burn_rates": {
+                    _fmt_window(w): round(self._burn(o.name, w, now), 3)
+                    for w in (self.fast_window, self.fast_long_window,
+                              self.slow_window)} if total else {},
+            }
+        return {
+            "enabled": self.enabled,
+            "evaluations": self.evaluations,
+            "evaluation_interval_seconds": self.eval_interval,
+            "fast_burn_threshold": self.fast_burn_threshold,
+            "slow_burn_threshold": self.slow_burn_threshold,
+            "windows_seconds": [self.fast_window, self.fast_long_window,
+                                self.slow_window],
+            "objectives": objectives,
+            "alerts": list(self.alerts),
+        }
+
+    def payload(self) -> dict:
+        with self._lock:
+            return self.payload_locked()
+
+    def worst_state(self) -> str:
+        """ok < warn < page — what cluster.check folds in."""
+        order = {"ok": 0, "warn": 1, "page": 2}
+        with self._lock:
+            states = list(self._state.values())
+        return max(states, key=lambda s: order.get(s, 0), default="ok")
+
+    # ---------------- lifecycle ----------------
+
+    def start(self) -> "SloEngine":
+        if not self.enabled or (self._thread is not None
+                                and self._thread.is_alive()):
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="slo-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.eval_interval):
+            try:
+                self.evaluate()
+            except Exception as e:  # noqa: BLE001 — engine must not die
+                glog.warning("slo evaluation failed: %s: %s",
+                             type(e).__name__, e)

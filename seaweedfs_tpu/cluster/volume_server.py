@@ -38,7 +38,7 @@ from ..storage.store import Store, StoreError
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
-from ..util import faults, glog, retry, security, tracing, varz
+from ..util import faults, glog, profiler, retry, security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import telemetry as telemetry_mod
 from .master import _grpc_port
@@ -159,6 +159,10 @@ class VolumeServer:
                                  name=f"volume-hb-{self.port}")
             t.start()
             self._threads.append(t)
+            # Tail-sampled slow/errored roots go to the master's
+            # collector; followers proxy the POST to the leader.
+            tracing.configure_push(self.master_url, node=self.url,
+                                   component="volume")
         glog.info("volume server started at %s (grpc %d)", self.url,
                   _grpc_port(self.port))
         return self
@@ -973,6 +977,13 @@ def _make_http_handler(vs: VolumeServer):
                 self._json(tracing.debug_payload(
                     int(q["limit"]) if "limit" in q else None))
                 return
+            if u.path == "/debug/profile":
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                self._send(200, profiler.profile(
+                    float(q.get("seconds", 2.0)),
+                    hz=float(q.get("hz", profiler.DEFAULT_BURST_HZ))
+                ).encode(), "text/plain; charset=utf-8")
+                return
             if u.path == "/debug/vars":
                 self._json(varz.payload(
                     "volume", vs.metrics,
@@ -1146,6 +1157,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     telemetry_mod.configure_from(conf)
     retry.configure_from(conf)
     faults.configure_from(conf)
+    profiler.configure_from(conf)
+    profiler.ensure_started()
     from ..pipeline import pipe as pipe_mod
     pipe_mod.configure_from(conf)
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,

@@ -28,6 +28,7 @@ from typing import Optional
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
 from ..util import glog
+from ..util import profiler
 from ..util import tracing
 from ..util import varz
 from ..util.stats import Metrics
@@ -584,11 +585,19 @@ def _make_handler(gw: S3Gateway):
         # -- verbs --
 
         def do_GET(self):
-            if urllib.parse.urlsplit(self.path).path == "/debug/vars":
+            u = urllib.parse.urlsplit(self.path)
+            if u.path == "/debug/vars":
                 import json
 
                 self._send(200, json.dumps(varz.payload(
                     "s3", gw.metrics)).encode(), "application/json")
+                return
+            if u.path == "/debug/profile":
+                q = dict(urllib.parse.parse_qsl(u.query))
+                self._send(200, profiler.profile(
+                    float(q.get("seconds", 2.0)),
+                    hz=float(q.get("hz", profiler.DEFAULT_BURST_HZ))
+                ).encode(), "text/plain; charset=utf-8")
                 return
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="GET").inc()

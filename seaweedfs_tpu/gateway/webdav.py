@@ -173,6 +173,15 @@ def _make_handler(dav: WebDavServer):
                 self._send(200, json.dumps(varz.payload(
                     "webdav")).encode(), "application/json")
                 return
+            if path == "/debug/profile":
+                from ..util import profiler
+                q = dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlsplit(self.path).query))
+                self._send(200, profiler.profile(
+                    float(q.get("seconds", 2.0)),
+                    hz=float(q.get("hz", profiler.DEFAULT_BURST_HZ))
+                ).encode(), "text/plain; charset=utf-8")
+                return
             entry = self._lookup(path)
             if entry is None:
                 self._send(404)

@@ -30,6 +30,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases; prefer
+# the top-level API, fall back to the experimental home on older jax.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..ops import bitslice
 from ..ops.rs_jax import Encoder
 
@@ -91,7 +97,7 @@ def make_sharded_encode_step(encoder: Encoder, mesh: Mesh):
         total = jax.lax.psum(local, ("dp", "sp"))
         return parity, total
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=P("dp", None, "sp"),
         out_specs=(P("dp", None, "sp"), P()),
@@ -126,7 +132,7 @@ def make_sharded_train_step(encoder: Encoder, mesh: Mesh,
         mismatches = jax.lax.psum(local_bad, ("dp", "sp"))
         return parity, mismatches
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=P("dp", None, "sp"),
         out_specs=(P("dp", None, "sp"), P()),
@@ -153,7 +159,7 @@ def make_sharded_rebuild_step(encoder: Encoder, mesh: Mesh,
         local = jnp.sum(rebuilt.astype(jnp.uint32), dtype=jnp.uint32)
         return rebuilt, jax.lax.psum(local, ("dp", "sp"))
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=P("dp", None, "sp"),
         out_specs=(P("dp", None, "sp"), P()),
@@ -184,7 +190,7 @@ def _make_apply_only_step(coefs: np.ndarray, mesh: Mesh):
     else:
         def step(x):
             return bitslice.apply_gf_matrix(coefs, x)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=P("dp", None, "sp"),
         out_specs=P("dp", None, "sp"),

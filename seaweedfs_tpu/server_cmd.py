@@ -51,6 +51,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
     tls_mod.install_from_config(conf)
+    from .util import faults as faults_mod
+    from .util import profiler, retry, tracing
+    tracing.configure_from(conf)
+    retry.configure_from(conf)
+    faults_mod.configure_from(conf)
+    profiler.configure_from(conf)
+    profiler.ensure_started()
 
     from .cluster.master import MasterServer
     from .cluster.volume_server import VolumeServer
@@ -60,7 +67,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         ip=args.ip, port=args.master_port, secret=secret,
         pulse_seconds=args.pulseSeconds,
         peers=[x for x in args.peers.split(",") if x],
-        meta_dir=args.mdir or None).start()
+        meta_dir=args.mdir or None,
+        trace_ring_size=int(config_mod.lookup(
+            conf, "tracing.collector_ring_size", 256)))
+    if config_mod.lookup(conf, "slo") is not None:
+        master.slo.configure(conf)
+    master.start()
     store = Store(args.dir, max_volumes=args.volume_max,
                   needle_map=args.vol_index)
     store.load_existing()

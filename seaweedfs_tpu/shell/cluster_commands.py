@@ -1660,6 +1660,22 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
         env.println(line)
         if h["verdict"] == "unhealthy":
             problems += 1
+    # SLO burn-rate verdicts, same best-effort stance: a paging
+    # objective is a problem (the budget is burning too fast on both
+    # fast windows); a warning objective is surfaced only.
+    try:
+        slo = env._master_http("/cluster/slo")
+    except ShellError:
+        slo = {}
+    for name in sorted(slo.get("objectives", {})):
+        o = slo["objectives"][name]
+        if o.get("state", "ok") == "ok":
+            continue
+        burns = ", ".join(f"{w}={r}" for w, r in
+                          o.get("burn_rates", {}).items())
+        env.println(f"slo {name}: {o['state']} (burn {burns})")
+        if o["state"] == "page":
+            problems += 1
     env.println(f"cluster.check: {n_nodes} nodes, {len(vols)} volumes, "
                 f"{len(present)} ec volumes, {problems} problems")
     if problems:
@@ -1795,6 +1811,50 @@ def cmd_trace_dump(env: ClusterEnv, argv: list[str]) -> None:
         env.println("trace.dump: no completed traces")
 
 
+@cluster_command("trace.top")
+def cmd_trace_top(env: ClusterEnv, argv: list[str]) -> None:
+    """Worst cross-process traces from the master's tail-sampling
+    collector (/cluster/traces): errored traces first, then slowest,
+    each with a per-stage time breakdown so the slow hop is named."""
+    p = _parser("trace.top")
+    p.add_argument("-n", type=int, default=10,
+                   help="traces to show (worst first)")
+    p.add_argument("-stages", type=int, default=4,
+                   help="stages to show per trace")
+    args = p.parse_args(argv)
+    doc = env._master_http("/cluster/traces")
+    traces = doc.get("traces", [])
+    if not traces:
+        env.println(
+            "trace.top: no traces collected yet (servers push roots "
+            "slower than [tracing] push_threshold_seconds, and "
+            "errored ones, to the master)")
+        return
+    for t in traces:
+        stages: dict = {}
+        for s in t.get("spans", []):
+            stages[s["name"]] = (stages.get(s["name"], 0.0)
+                                 + float(s.get("duration_seconds")
+                                         or 0.0))
+        t["_stages"] = sorted(stages.items(), key=lambda kv: kv[1],
+                              reverse=True)
+    traces.sort(key=lambda t: (t.get("status", "ok") == "ok",
+                               -float(t.get("duration_seconds") or 0)))
+    shown = traces[:max(1, args.n)]
+    env.println(f"trace.top: {doc.get('count', len(traces))} stitched "
+                f"traces on the master (ring {doc.get('ring_size')}, "
+                f"ingested {doc.get('ingested')})")
+    for t in shown:
+        srcs = ",".join(sorted(t.get("sources", {})))
+        env.println(
+            f"{t['trace_id']}  {_fmt_ms(t.get('duration_seconds'))}ms "
+            f"{t.get('status', 'ok'):<5} {t.get('name') or '?'} "
+            f"[{'+'.join(t.get('reasons', []))}] "
+            f"spans={t.get('span_count', 0)} sources={srcs}")
+        for name, secs in t["_stages"][:max(0, args.stages)]:
+            env.println(f"    {_fmt_ms(secs):>9}ms  {name}")
+
+
 def _fmt_rate(v: float) -> str:
     return f"{v:.2f}" if v < 10 else f"{v:.0f}"
 
@@ -1888,6 +1948,17 @@ def cmd_volume_heatmap(env: ClusterEnv, argv: list[str]) -> None:
             f"{r['node']:<21} {_fmt_rate(r['reads']):>8} "
             f"{_fmt_rate(r['writes']):>8} {hitp:>6} "
             f"{_fmt_ms(r['p99']):>7}  {bar}")
+    # What CODE is hot on each node: the continuous profiler's top
+    # stacks ride the heartbeat telemetry (leaf frame shown; the full
+    # collapsed stacks come from /debug/profile on the node).
+    hot = {url: n.get("hot_stacks") or []
+           for url, n in doc.get("nodes", {}).items()}
+    if any(hot.values()):
+        env.println("hot code (continuous profiler, samples):")
+        for url in sorted(hot):
+            for s in hot[url][:3]:
+                leaf = s["stack"].rsplit(";", 1)[-1]
+                env.println(f"  {url:<21} {s['samples']:>7}  {leaf}")
 
 
 def run_cluster_command(env: ClusterEnv, line: str) -> None:

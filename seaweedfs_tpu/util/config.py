@@ -62,6 +62,10 @@ segments = 4
 enabled = true                   # false strips all span bookkeeping
 ring_size = 256                  # completed traces kept per process
 slow_threshold_seconds = 1.0     # slower roots log a span-tree line
+push_threshold_seconds = 1.0     # slower/errored roots push to master
+collector_url = ""               # master host:port override (servers
+                                 # that know their master set it)
+collector_ring_size = 256        # stitched traces kept on the master
 """,
     "telemetry": """\
 # telemetry.toml — heartbeat-carried per-volume hot stats
@@ -96,6 +100,33 @@ pool_buffers = 0                 # reusable host buffers; 0 = derive
 feedback = true                  # latency-fed group-size controller
 overlapped = true                # false = synchronous reference path
 preallocate = true               # size shard files up front
+""",
+    "profiler": """\
+# profiler.toml — continuous sampling profiler (docs/observability.md).
+[profiler]
+enabled = true                   # always-on low-rate sampler thread
+hz = 1.0                         # background sampling rate
+top_k = 5                        # hot stacks carried on heartbeats
+max_stacks = 512                 # distinct collapsed stacks retained
+""",
+    "slo": """\
+# slo.toml — master-side SLO burn-rate engine (docs/observability.md).
+# Latency objectives are "no more than 1% of ops slower than the
+# target"; availability is the fraction of ops that must succeed.
+# Burn rate = observed bad-event rate / budgeted bad-event rate,
+# evaluated over fast (5m + 1h) and slow (6h) windows (SRE multiwindow
+# multi-burn-rate alerting): fast windows page, the slow window warns.
+[slo]
+enabled = true
+read_p99_ms = 250.0              # volume read latency target; 0 = off
+write_p99_ms = 500.0             # volume write latency target; 0 = off
+availability = 0.999             # min ok fraction; 0 = off
+evaluation_interval_seconds = 5.0
+fast_burn_threshold = 14.4       # burns 2% of a 30d budget in 1h
+slow_burn_threshold = 6.0        # burns 5% of a 30d budget in 6h
+fast_window_seconds = 300.0      # paired with fast_long_window
+fast_long_window_seconds = 3600.0
+slow_window_seconds = 21600.0
 """,
     "faults": """\
 # faults.toml — deterministic fault injection (docs/robustness.md).

@@ -32,18 +32,33 @@ def set_verbosity(n: int) -> None:
     VERBOSITY = n
 
 
+def _trace_suffix() -> str:
+    """`` trace=<id> span=<id>`` when this thread is inside an active
+    span, else "" — the glue that lets ``trace.top`` output grep
+    straight into server logs. Looked up through sys.modules because
+    tracing imports glog (never the other way around); until tracing is
+    loaded there is no span to correlate anyway."""
+    tracing = sys.modules.get("seaweedfs_tpu.util.tracing")
+    if tracing is None or not tracing.active():
+        return ""
+    sp = tracing.current_span()
+    if sp is None:
+        return ""
+    return f" trace={sp.trace_id} span={sp.span_id}"
+
+
 def v(level: int, fmt: str, *args) -> None:
     if VERBOSITY >= level:
-        _logger.info(fmt, *args)
+        _logger.info(fmt + _trace_suffix(), *args)
 
 
 def info(fmt: str, *args) -> None:
-    _logger.info(fmt, *args)
+    _logger.info(fmt + _trace_suffix(), *args)
 
 
 def warning(fmt: str, *args) -> None:
-    _logger.warning(fmt, *args)
+    _logger.warning(fmt + _trace_suffix(), *args)
 
 
 def error(fmt: str, *args) -> None:
-    _logger.error(fmt, *args)
+    _logger.error(fmt + _trace_suffix(), *args)

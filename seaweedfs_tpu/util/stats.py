@@ -9,8 +9,9 @@ Self-contained (no prometheus client dependency).
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_right
-from typing import Iterable
+from typing import Iterable, Optional
 
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -63,13 +64,21 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.total = 0.0
         self.n = 0
+        #: bucket index -> (exemplar_id, value, unix_ts): the most
+        #: recent exemplar observed per bucket (one slot per bucket
+        #: keeps storage O(buckets), never O(observations)).
+        self.exemplars: dict[int, tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         with self._lock:
-            self.counts[bisect_right(self.buckets, value)] += 1
+            i = bisect_right(self.buckets, value)
+            self.counts[i] += 1
             self.total += value
             self.n += 1
+            if exemplar:
+                self.exemplars[i] = (exemplar, value, time.time())
 
 
 class Digest:
@@ -223,6 +232,36 @@ class Digest:
                     return m0 + (m1 - m0) * (target - c0) / (c1 - c0)
             return self.max
 
+    def cdf(self, x: float) -> float:
+        """Estimate the fraction of samples <= ``x`` (the inverse of
+        :meth:`quantile`, same midpoint interpolation); NaN when empty.
+        This is what lets an SLO engine turn a latency digest into a
+        good/bad event ratio ("what fraction of reads beat 250 ms")."""
+        x = float(x)
+        with self._lock:
+            self._compact_locked()
+            if not self._means:
+                return float("nan")
+            if x < self.min:
+                return 0.0
+            if x >= self.max:
+                return 1.0
+            total = sum(self._weights)
+            cum = 0.0
+            pts = [(0.0, self.min)]
+            for m, w in zip(self._means, self._weights):
+                pts.append((cum + w / 2.0, m))
+                cum += w
+            pts.append((total, self.max))
+            for i in range(len(pts) - 1):
+                c0, m0 = pts[i]
+                c1, m1 = pts[i + 1]
+                if x <= m1:
+                    if m1 == m0:
+                        return c1 / total
+                    return (c0 + (c1 - c0) * (x - m0) / (m1 - m0)) / total
+            return 1.0
+
     def percentiles(self, *qs: float) -> dict[str, float]:
         """Convenience: {"p50": ..., "p99": ...} for the given qs."""
         return {"p" + ("%g" % (q * 100)).replace(".", "_"):
@@ -330,6 +369,22 @@ class Metrics:
                     f"{full}_bucket{_fmt_labels(le)} {m.n}")
                 lines.append(f"{full}_sum{lab} {m.total}")
                 lines.append(f"{full}_count{lab} {m.n}")
+                # Exemplars ride as comment lines, NOT OpenMetrics
+                # ``... # {trace_id=..}`` suffixes: the 0.0.4 text
+                # format (and the strict mini parser the smoke scripts
+                # run) treats unknown ``#`` lines as comments, so the
+                # trace link is greppable without breaking any scraper.
+                # trace_id here is an exemplar annotation, not a metric
+                # label — cardinality stays one slot per bucket.
+                for i in sorted(m.exemplars):
+                    ex_id, ex_val, ex_ts = m.exemplars[i]
+                    le = dict(labels)
+                    le["le"] = ("%g" % m.buckets[i]
+                                if i < len(m.buckets) else "+Inf")
+                    lines.append(
+                        f"# EXEMPLAR {full}_bucket{_fmt_labels(le)} "
+                        f'trace_id="{_escape_label_value(ex_id)}" '
+                        f"value={ex_val:g} ts={ex_ts:.3f}")
         return "\n".join(lines) + "\n"
 
 

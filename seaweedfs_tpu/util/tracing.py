@@ -25,12 +25,13 @@ Config lives in a ``[tracing]`` TOML block (see ``config.SCAFFOLDS``):
 from __future__ import annotations
 
 import functools
+import json
 import os
 import random
 import threading
 import time
-from collections import deque
-from typing import Optional
+from collections import OrderedDict, deque
+from typing import Callable, Optional, Union
 
 from . import glog, stats
 
@@ -55,6 +56,23 @@ _SLOW_RING: deque = deque(maxlen=64)
 #: flood the ring buffer with single-span traces.
 _UNTRACED_PATHS = frozenset(("/metrics", "/status", "/healthz"))
 _UNTRACED_PREFIXES = ("/debug/", "/cluster/", "/dir/status", "/raft/")
+
+# -- tail-sampled collection ------------------------------------------------
+#: Push target for completed local roots that are slow or errored:
+#: either an HTTP URL string ("host:port" of the master — the bundle is
+#: POSTed to /cluster/traces through the resilient retry layer) or a
+#: callable taking the payload dict (the master ingests its own traces
+#: in-process instead of dialing itself). None disables pushing.
+_PUSH_TARGET: Union[str, Callable, None] = None
+_PUSH_NODE = ""           # this process's advertised host:port
+_PUSH_COMPONENT = ""      # master / volume / filer / s3 / webdav
+_PUSH_THRESHOLD: Optional[float] = None  # None -> slow threshold
+#: Bounded hand-off queue to the push worker; the request thread only
+#: appends — a slow or absent master must never block the data path.
+_PUSH_QUEUE: deque = deque(maxlen=64)
+_PUSH_WAKE = threading.Event()
+_PUSH_THREAD: Optional[threading.Thread] = None
+_PUSH_STATS = {"pushed": 0, "errors": 0, "dropped": 0}
 
 
 class Span:
@@ -153,6 +171,96 @@ def configure_from(conf: dict) -> None:
         ring_size=config_mod.lookup(conf, "tracing.ring_size"),
         slow_threshold_seconds=config_mod.lookup(
             conf, "tracing.slow_threshold_seconds"))
+    global _PUSH_THRESHOLD
+    thr = config_mod.lookup(conf, "tracing.push_threshold_seconds")
+    if thr is not None:
+        _PUSH_THRESHOLD = float(thr)
+    url = config_mod.lookup(conf, "tracing.collector_url")
+    if url:
+        configure_push(url)
+
+
+def configure_push(target: Union[str, Callable, None],
+                   node: Optional[str] = None,
+                   component: Optional[str] = None,
+                   threshold_seconds: Optional[float] = None) -> None:
+    """Enable (or disable, with ``target=None``) tail-sampled pushing
+    of slow/errored local roots. ``target`` is the master's
+    ``host:port`` (POSTed to ``/cluster/traces``) or a callable payload
+    sink (the master's own in-process collector)."""
+    global _PUSH_TARGET, _PUSH_NODE, _PUSH_COMPONENT, _PUSH_THRESHOLD
+    _PUSH_TARGET = target
+    if node is not None:
+        _PUSH_NODE = node
+    if component is not None:
+        _PUSH_COMPONENT = component
+    if threshold_seconds is not None:
+        _PUSH_THRESHOLD = float(threshold_seconds)
+    if target is not None:
+        _ensure_push_worker()
+
+
+def push_threshold() -> float:
+    return (_PUSH_THRESHOLD if _PUSH_THRESHOLD is not None
+            else _SLOW_THRESHOLD)
+
+
+def _ensure_push_worker() -> None:
+    global _PUSH_THREAD
+    if _PUSH_THREAD is not None and _PUSH_THREAD.is_alive():
+        return
+    t = threading.Thread(target=_push_loop, daemon=True,
+                         name="trace-push")
+    _PUSH_THREAD = t
+    t.start()
+
+
+def _push_loop() -> None:
+    while True:
+        _PUSH_WAKE.wait()
+        _PUSH_WAKE.clear()
+        while _PUSH_QUEUE:
+            try:
+                payload = _PUSH_QUEUE.popleft()
+            except IndexError:
+                break
+            target = _PUSH_TARGET
+            if target is None:
+                continue
+            try:
+                if callable(target):
+                    target(payload)
+                else:
+                    from . import retry
+                    retry.http_request(
+                        f"http://{target}/cluster/traces",
+                        data=json.dumps(payload).encode(),
+                        method="POST",
+                        headers={"Content-Type": "application/json"},
+                        point="trace.push", timeout=5.0,
+                        use_breaker=False)
+                _PUSH_STATS["pushed"] += 1
+            except Exception:  # noqa: BLE001 — collection is best-effort
+                _PUSH_STATS["errors"] += 1
+
+
+def _enqueue_push(root: Span, spans: list, reason: str) -> None:
+    if len(_PUSH_QUEUE) >= (_PUSH_QUEUE.maxlen or 0):
+        _PUSH_STATS["dropped"] += 1
+    _PUSH_QUEUE.append({
+        "node": _PUSH_NODE,
+        "component": _PUSH_COMPONENT,
+        "reason": reason,
+        "bundle": _bundle(root, spans),
+    })
+    _PUSH_WAKE.set()
+
+
+def push_stats() -> dict:
+    return dict(_PUSH_STATS,
+                queued=len(_PUSH_QUEUE),
+                target=(_PUSH_TARGET if isinstance(_PUSH_TARGET, str)
+                        else bool(_PUSH_TARGET)))
 
 
 def enabled() -> bool:
@@ -241,7 +349,10 @@ def _instruments(name: str) -> tuple:
 
 def _record(sp: Span) -> None:
     hist, ok, err, nbytes = _instruments(sp.name)
-    hist.observe(sp.duration)
+    # The trace id rides the histogram bucket as an exemplar: a scrape
+    # showing a fat p99 bucket names the exact trace to pull from
+    # /cluster/traces (one slot per bucket, no cardinality growth).
+    hist.observe(sp.duration, exemplar=sp.trace_id)
     (ok if sp.status == "ok" else err).inc()
     if sp.n_bytes:
         nbytes.inc(sp.n_bytes)
@@ -274,6 +385,14 @@ def _finish(sp: Span, exc: Optional[BaseException]) -> None:
             })
             glog.warning("slow trace %s %s %.3fs: %s", sp.trace_id,
                          sp.name, sp.duration, summary)
+        if _PUSH_TARGET is not None:
+            # Tail sampling: only roots that turned out slow or errored
+            # leave the process — the head-sampled firehose stays local.
+            slow = sp.duration >= push_threshold()
+            errored = sp.status != "ok"
+            if slow or errored:
+                _enqueue_push(sp, spans,
+                              "slow" if slow else "error")
 
 
 class _SpanHandle:
@@ -449,6 +568,139 @@ def render_trace(trace: dict) -> str:
     for r in sorted(roots, key=lambda r: r["start"]):
         walk(r, 0)
     return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# master-side tail-sampled trace collection
+# --------------------------------------------------------------------------
+
+class TraceCollector:
+    """Cluster-wide store for tail-sampled traces.
+
+    Every server pushes its slow/errored local roots here (HTTP POST
+    ``/cluster/traces``, or a direct call for the master's own traces);
+    bundles sharing a trace id are stitched into ONE cross-process
+    trace, so ``/cluster/traces`` shows the gateway, filer, master and
+    volume legs of a bad request together. Bounded two ways: at most
+    ``ring_size`` traces (oldest evicted) and ``max_spans`` spans per
+    trace (extra spans counted, not stored). Span-id dedup makes
+    re-delivery through the retry layer idempotent.
+    """
+
+    MAX_SPANS = 512
+
+    def __init__(self, ring_size: int = 256):
+        self.ring_size = max(1, int(ring_size))
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self.ingested = 0
+        self.rejected = 0
+
+    def ingest(self, payload: dict) -> None:
+        """Fold one pushed ``{node, component, reason, bundle}`` in."""
+        bundle = (payload or {}).get("bundle") or {}
+        trace_id = bundle.get("trace_id")
+        spans = bundle.get("spans") or []
+        if not trace_id or not isinstance(spans, list):
+            self.rejected += 1
+            return
+        node = str(payload.get("node") or "")
+        component = str(payload.get("component") or "")
+        source = f"{component}@{node}" if component or node else "?"
+        reason = str(payload.get("reason") or "slow")
+        is_root = not bundle.get("remote_parent")
+        with self._lock:
+            e = self._traces.get(trace_id)
+            if e is None:
+                e = {"trace_id": trace_id, "name": bundle.get("name"),
+                     "first_ts": bundle.get("start"),
+                     "last_ts": bundle.get("start"),
+                     "duration_seconds": 0.0, "status": "ok",
+                     "reasons": [], "sources": {}, "spans": [],
+                     "span_count": 0, "has_root": False,
+                     "_span_ids": set()}
+                self._traces[trace_id] = e
+                while len(self._traces) > self.ring_size:
+                    self._traces.popitem(last=False)
+            else:
+                self._traces.move_to_end(trace_id)
+            self.ingested += 1
+            start = bundle.get("start")
+            if start is not None:
+                if e["first_ts"] is None or start < e["first_ts"]:
+                    e["first_ts"] = start
+                if e["last_ts"] is None or start > e["last_ts"]:
+                    e["last_ts"] = start
+            # The true root bundle (no upstream context) names the
+            # trace and sets its end-to-end duration; until one lands,
+            # the longest local root stands in.
+            dur = float(bundle.get("duration_seconds") or 0.0)
+            if is_root and not e["has_root"]:
+                e["has_root"] = True
+                e["name"] = bundle.get("name")
+                e["duration_seconds"] = dur
+            elif is_root == e["has_root"] and dur > e["duration_seconds"]:
+                if not e["has_root"]:
+                    e["name"] = bundle.get("name")
+                e["duration_seconds"] = dur
+            st = bundle.get("status", "ok")
+            if st != "ok" and e["status"] == "ok":
+                e["status"] = st
+            if reason not in e["reasons"]:
+                e["reasons"].append(reason)
+            for s in spans:
+                sid = s.get("span_id")
+                if sid in e["_span_ids"]:
+                    continue
+                e["_span_ids"].add(sid)
+                e["span_count"] += 1
+                e["sources"][source] = e["sources"].get(source, 0) + 1
+                if len(e["spans"]) < self.MAX_SPANS:
+                    s = dict(s)
+                    s["node"] = source
+                    e["spans"].append(s)
+
+    @staticmethod
+    def _public(e: dict) -> dict:
+        return {k: v for k, v in e.items() if not k.startswith("_")}
+
+    def traces(self, limit: Optional[int] = None) -> list[dict]:
+        """Stitched traces, most recently touched last."""
+        with self._lock:
+            entries = [self._public(e) for e in self._traces.values()]
+        if limit is not None and limit >= 0:
+            entries = entries[-limit:] if limit else []
+        return entries
+
+    def top(self, limit: int = 10) -> list[dict]:
+        """Worst traces first (errored above slow, then by duration),
+        each with a per-stage time breakdown — the ``trace.top`` view."""
+        with self._lock:
+            entries = [self._public(e) for e in self._traces.values()]
+        for e in entries:
+            stages: dict[str, float] = {}
+            for s in e["spans"]:
+                stages[s["name"]] = (stages.get(s["name"], 0.0)
+                                     + float(s.get("duration_seconds")
+                                             or 0.0))
+            e["stages"] = dict(sorted(stages.items(),
+                                      key=lambda kv: kv[1],
+                                      reverse=True))
+        entries.sort(key=lambda e: (e["status"] == "ok",
+                                    -e["duration_seconds"]))
+        return entries[:max(0, int(limit))]
+
+    def payload(self, limit: Optional[int] = None) -> dict:
+        """The ``/cluster/traces`` JSON body."""
+        with self._lock:
+            count = len(self._traces)
+        return {
+            "ring_size": self.ring_size,
+            "count": count,
+            "ingested": self.ingested,
+            "rejected": self.rejected,
+            "traces": self.traces(limit),
+        }
 
 
 # --------------------------------------------------------------------------

@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Optional
 
-from . import tracing
+from . import profiler, tracing
 from .stats import Metrics
 
 try:
@@ -66,8 +66,10 @@ def payload(component: str, metrics: Optional[Metrics] = None,
         "threads": threading.active_count(),
         "gc_counts": gc.get_count(),
         "slow_requests": tracing.slow_requests(),
+        "trace_push": tracing.push_stats(),
         "breakers": retry.breakers_payload(),
         "faults": faults.debug_payload(),
+        "profiler": profiler.debug_payload(),
         "pipeline": _pipeline_payload(),
     }
     rss = _rss_bytes()

@@ -1,0 +1,496 @@
+"""Cluster observability plane: tail-sampled traces, SLO burn rates,
+continuous profiling (docs/observability.md).
+
+Unit coverage for the three new pieces — the master's TraceCollector
+(stitching, dedup, eviction, ranking), the SloEngine (multi-window
+burn-rate math, page/warn transitions, gauge export), and the sampling
+profiler (burst + always-on) — plus the end-to-end acceptance test: a
+real in-process mini-cluster with a latency fault on the volume read
+path, observed ONLY through the master's endpoints.
+"""
+
+import json
+import math
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from conftest import parse_exposition
+from seaweedfs_tpu.cluster.filer_server import FilerServer
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.telemetry import SloEngine
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.shell.cluster_commands import run_cluster_command
+from seaweedfs_tpu.shell.commands import ShellError
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.util import faults, glog, profiler, retry, tracing
+from seaweedfs_tpu.util.stats import Digest, Metrics
+
+from test_chaos_integration import _free_port_pair
+from test_cluster_shell import _env
+
+PULSE = 0.2
+
+
+@pytest.fixture(autouse=True)
+def _observability_hygiene():
+    """Push config, faults, and the profiler are process-global; tests
+    here reconfigure all three, so restore the defaults afterwards."""
+    saved = {k: getattr(retry.policy(), k)
+             for k in ("base_delay", "max_delay", "breaker_cooldown")}
+    retry.configure(base_delay=0.01, max_delay=0.1,
+                    breaker_cooldown=0.5)
+    faults.clear()
+    retry.reset_breakers()
+    yield
+    tracing.configure_push(None)
+    tracing._PUSH_THRESHOLD = None
+    profiler.configure(enabled=False)
+    profiler.reset()
+    faults.clear()
+    retry.reset_breakers()
+    retry.configure(**saved)
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+
+def _busy(stop: threading.Event) -> None:
+    while not stop.is_set():
+        sum(i * i for i in range(500))
+
+
+def test_profiler_burst_sees_running_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy, args=(stop,), name="prof-busy")
+    t.start()
+    try:
+        text = profiler.profile(seconds=0.3, hz=97)
+    finally:
+        stop.set()
+        t.join()
+    assert text, "burst capture returned no stacks"
+    lines = text.strip().splitlines()
+    # collapsed format: "frame;frame;... count"
+    for line in lines:
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) >= 1
+        assert ":" in stack
+    assert any("_busy" in ln for ln in lines), lines[:5]
+
+
+def test_profiler_always_on_aggregates_hot_stacks():
+    profiler.reset()
+    profiler.configure(enabled=True, hz=200.0, top_k=3)
+    try:
+        stop = threading.Event()
+        t = threading.Thread(target=_busy, args=(stop,))
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not profiler.hot_stacks():
+            time.sleep(0.05)
+        stop.set()
+        t.join()
+        hot = profiler.hot_stacks()
+        assert hot, "always-on sampler collected nothing"
+        assert len(hot) <= 3
+        stack, count = hot[0]
+        assert count >= 1 and ";" in stack or ":" in stack
+        payload = profiler.debug_payload()
+        assert payload["enabled"] and payload["samples"] >= 1
+    finally:
+        profiler.configure(enabled=False)
+    assert profiler.debug_payload()["running"] is False
+
+
+def test_profiler_burst_clamps_rate_and_duration():
+    t0 = time.monotonic()
+    profiler.profile(seconds=0.05, hz=10_000)
+    assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# Digest.cdf — the latency-objective primitive
+# ---------------------------------------------------------------------------
+
+def test_digest_cdf_edges_and_interpolation():
+    d = Digest()
+    assert math.isnan(d.cdf(1.0))
+    for v in (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0):
+        d.add(v)
+    assert d.cdf(0.01) == 0.0
+    assert d.cdf(1.0) == 1.0
+    assert d.cdf(99.0) == 1.0
+    mid = d.cdf(0.5)
+    assert 0.3 < mid < 0.7, mid
+    # monotone over the support
+    xs = [0.15, 0.35, 0.55, 0.75, 0.95]
+    cs = [d.cdf(x) for x in xs]
+    assert cs == sorted(cs), cs
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplars_render_and_stay_parseable():
+    m = Metrics(namespace="ex")
+    m.histogram("request_stage_seconds", stage="read").observe(
+        0.004, exemplar="cafecafecafecafe")
+    m.histogram("request_stage_seconds", stage="read").observe(0.002)
+    text = m.render()
+    fams = parse_exposition(text)  # raises on malformed lines
+    assert any(k.startswith("ex_request_stage_seconds") for k in fams)
+    ex_lines = [ln for ln in text.splitlines()
+                if ln.startswith("# EXEMPLAR ")]
+    assert ex_lines, text
+    assert any('trace_id="cafecafecafecafe"' in ln for ln in ex_lines)
+
+
+# ---------------------------------------------------------------------------
+# TraceCollector
+# ---------------------------------------------------------------------------
+
+def _bundle(trace_id, name, dur, *, span_ids, status="ok",
+            remote_parent="", start=1000.0):
+    return {"trace_id": trace_id, "name": name, "start": start,
+            "duration_seconds": dur, "status": status,
+            "remote_parent": remote_parent,
+            "spans": [{"span_id": s, "name": f"{name}/{s}",
+                       "duration_seconds": dur / len(span_ids)}
+                      for s in span_ids]}
+
+
+def test_collector_stitches_cross_process_bundles():
+    c = tracing.TraceCollector(ring_size=8)
+    c.ingest({"node": "127.0.0.1:81", "component": "volume",
+              "reason": "slow",
+              "bundle": _bundle("t1", "volume.GET", 0.4,
+                                span_ids=["v1"],
+                                remote_parent="abc")})
+    c.ingest({"node": "127.0.0.1:88", "component": "filer",
+              "reason": "slow",
+              "bundle": _bundle("t1", "filer.GET", 0.5,
+                                span_ids=["f1", "f2"])})
+    traces = c.traces()
+    assert len(traces) == 1
+    t = traces[0]
+    assert t["span_count"] == 3
+    assert t["has_root"] is True
+    # the true root (no remote parent) names the trace end to end
+    assert t["name"] == "filer.GET"
+    assert t["duration_seconds"] == 0.5
+    assert set(t["sources"]) == {"volume@127.0.0.1:81",
+                                 "filer@127.0.0.1:88"}
+
+
+def test_collector_dedups_redelivered_spans():
+    c = tracing.TraceCollector()
+    payload = {"node": "n", "component": "volume", "reason": "error",
+               "bundle": _bundle("t2", "volume.GET", 0.1,
+                                 span_ids=["a", "b"], status="error")}
+    c.ingest(payload)
+    c.ingest(json.loads(json.dumps(payload)))  # retry re-delivery
+    t = c.traces()[0]
+    assert t["span_count"] == 2
+    assert t["status"] == "error"
+    assert c.ingested == 2
+
+
+def test_collector_bounds_ring_and_rejects_garbage():
+    c = tracing.TraceCollector(ring_size=3)
+    for i in range(5):
+        c.ingest({"node": "n", "component": "volume", "reason": "slow",
+                  "bundle": _bundle(f"t{i}", "volume.GET", 0.1 * (i + 1),
+                                    span_ids=[f"s{i}"])})
+    assert len(c.traces()) == 3
+    assert {t["trace_id"] for t in c.traces()} == {"t2", "t3", "t4"}
+    c.ingest({"bundle": {"spans": []}})      # no trace id
+    c.ingest({})                             # no bundle
+    assert c.rejected == 2
+
+
+def test_collector_top_ranks_errors_then_duration():
+    c = tracing.TraceCollector()
+    c.ingest({"node": "n", "component": "f", "reason": "slow",
+              "bundle": _bundle("slowest", "a", 9.0, span_ids=["1"])})
+    c.ingest({"node": "n", "component": "f", "reason": "error",
+              "bundle": _bundle("errored", "b", 0.2, span_ids=["2"],
+                                status="error")})
+    c.ingest({"node": "n", "component": "f", "reason": "slow",
+              "bundle": _bundle("slower", "c", 1.0, span_ids=["3"])})
+    order = [t["trace_id"] for t in c.top()]
+    assert order == ["errored", "slowest", "slower"]
+    assert all("stages" in t for t in c.top())
+
+
+# ---------------------------------------------------------------------------
+# SloEngine
+# ---------------------------------------------------------------------------
+
+class _FakeTelemetry:
+    """Scriptable stand-in for ClusterTelemetry: each evaluation tick
+    pops the next (counters, read_digest) frame."""
+
+    def __init__(self):
+        self.frames = []
+
+    def push_frame(self, ops, errors, latencies):
+        d = None
+        if latencies:
+            d = Digest()
+            for v in latencies:
+                d.add(v)
+        self.frames.append(({"ops": ops, "errors": errors}, d))
+        return self
+
+    def cluster_counters(self):
+        return dict(self.frames[0][0]) if len(self.frames) == 1 \
+            else dict(self.frames.pop(0)[0])
+
+    def digests_since(self, ts, read=True):
+        if not read:
+            return None
+        return self.frames[0][1] if len(self.frames) == 1 else None
+
+
+def _engine(tele, now=[0.0]):
+    eng = SloEngine(tele, clock=lambda: now[0])
+    eng.configure({"slo": {
+        "enabled": True, "read_p99_ms": 100.0, "availability": 0.999,
+        "evaluation_interval_seconds": 0.05}})
+    return eng, now
+
+
+def test_slo_engine_pages_on_fast_burn_and_exports_gauges():
+    tele = _FakeTelemetry()
+    # frame 1 primes the counters; frame 2 is the degraded interval:
+    # every read 400 ms against a 100 ms target, 5% hard errors.
+    tele.push_frame(0, 0, None)
+    tele.push_frame(1000, 50, [0.4] * 64)
+    eng, now = _engine(tele)
+    eng.evaluate()
+    now[0] += 1.0
+    doc = eng.evaluate()
+    read = doc["objectives"]["read_p99_ms"]
+    assert read["state"] == "page", doc
+    # all mass above target / 1% budget -> burn 100 on every window
+    assert read["burn_rates"]["5m"] > 14.4
+    assert read["burn_rates"]["1h"] > 14.4
+    avail = doc["objectives"]["availability"]
+    # 5% errors / 0.1% budget -> burn 50
+    assert avail["state"] == "page"
+    assert 40 < avail["burn_rates"]["5m"] < 60
+    assert eng.worst_state() == "page"
+    assert [a for a in eng.alerts if a["to"] == "page"]
+    fams = parse_exposition(eng.metrics.render())
+    vals = [v for labels, v in fams["seaweed_slo_burn_rate"]
+            if labels == {"slo": "read_p99_ms", "window": "5m"}]
+    assert vals and vals[0] > 14.4, fams
+
+
+def test_slo_engine_recovers_to_ok_as_windows_drain():
+    tele = _FakeTelemetry()
+    tele.push_frame(0, 0, None)
+    tele.push_frame(1000, 0, [0.4] * 64)
+    eng, now = _engine(tele)
+    eng.fast_window = 10.0
+    eng.fast_long_window = 20.0
+    eng.slow_window = 40.0
+    eng.evaluate()
+    now[0] += 1.0
+    assert eng.evaluate()["objectives"]["read_p99_ms"]["state"] == "page"
+    # healthy traffic from here on; the bad interval ages out
+    for _ in range(6):
+        now[0] += 10.0
+        tele.push_frame(2000, 0, [0.001] * 64)
+        doc = eng.evaluate()
+    assert doc["objectives"]["read_p99_ms"]["state"] == "ok"
+    transitions = [(a["from"], a["to"]) for a in eng.alerts
+                   if a["slo"] == "read_p99_ms"]
+    assert ("ok", "page") in transitions
+    assert transitions[-1][1] == "ok"
+
+
+def test_slo_engine_disabled_and_validation():
+    eng = SloEngine(_FakeTelemetry())
+    doc = eng.evaluate()
+    assert doc["enabled"] is False and doc["objectives"] == {}
+    with pytest.raises(ValueError):
+        eng.configure({"slo": {"enabled": True, "availability": 1.2}})
+
+
+# ---------------------------------------------------------------------------
+# glog <-> tracing correlation and tail-sample pushing
+# ---------------------------------------------------------------------------
+
+def test_glog_lines_carry_trace_ids_inside_spans():
+    import logging
+    messages = []
+    h = logging.Handler()
+    h.emit = lambda r: messages.append(r.getMessage())
+    glog._logger.addHandler(h)
+    try:
+        with tracing.start_trace("glogtest") as sp:
+            glog.info("inside the span")
+            want = f"trace={sp.trace_id} span={sp.span_id}"
+        glog.info("outside any span")
+    finally:
+        glog._logger.removeHandler(h)
+    assert messages[0] == f"inside the span {want.strip()}" \
+        or want in messages[0], messages
+    assert messages[1] == "outside any span"
+
+
+def test_slow_roots_push_to_configured_sink():
+    got = []
+    tracing.configure_push(got.append, node="here", component="test",
+                           threshold_seconds=0.05)
+    with tracing.start_trace("push.slow"):
+        time.sleep(0.08)
+    with tracing.start_trace("push.fast"):
+        pass
+    deadline = time.time() + 5
+    while time.time() < deadline and not got:
+        time.sleep(0.01)
+    assert len(got) == 1, got
+    p = got[0]
+    assert p["reason"] == "slow" and p["component"] == "test"
+    assert p["bundle"]["name"] == "push.slow"
+    assert tracing.push_stats()["pushed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: latency fault on one volume server, observed from the
+# master only (the ISSUE's acceptance test)
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _get_json(url, timeout=15):
+    return json.loads(_get(url, timeout))
+
+
+def test_cluster_observability_end_to_end(tmp_path):
+    master = MasterServer(port=_free_port_pair(),
+                          volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=11).start()
+    vdir = tmp_path / "v0"
+    vdir.mkdir()
+    vol = VolumeServer(Store([vdir], max_volumes=8),
+                       port=_free_port_pair(), master_url=master.url,
+                       pulse_seconds=PULSE).start()
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topology.nodes:
+            time.sleep(0.05)
+        assert master.topology.nodes, "volume server never joined"
+
+        # Aggressive-but-real settings so the test converges in
+        # seconds: tail-sample anything over 200 ms, page when reads
+        # breach a 100 ms p99 target.
+        tracing.configure_push(master.url, node=vol.url,
+                               component="volume",
+                               threshold_seconds=0.2)
+        master.slo.configure({"slo": {
+            "enabled": True, "read_p99_ms": 100.0,
+            "availability": 0.999,
+            "evaluation_interval_seconds": 0.1}})
+
+        base = f"http://{master.url}"
+        put = urllib.request.Request(
+            f"http://{filer.url}/obs/blob.bin", data=b"x" * 4096,
+            method="PUT")
+        with urllib.request.urlopen(put, timeout=15) as r:
+            assert r.status in (200, 201)
+
+        # The latency fault (PR 5 plane) on the volume read path: the
+        # delay lands inside the server's timed read region, so it
+        # shows up in telemetry digests AND pushes the request root
+        # over the tail-sampling threshold.
+        faults.inject("volume.read", "delay:0.35")
+        for _ in range(4):
+            assert _get(f"http://{filer.url}/obs/blob.bin") \
+                == b"x" * 4096
+
+        # 1. the slow trace is stitched at the master with both the
+        #    filer and volume legs.
+        deadline = time.time() + 15
+        stitched = None
+        while time.time() < deadline and stitched is None:
+            doc = _get_json(f"{base}/cluster/traces")
+            for t in doc["traces"]:
+                names = {s["name"] for s in t["spans"]}
+                if {"filer.GET", "volume.GET"} <= names:
+                    stitched = t
+                    break
+            time.sleep(0.1)
+        assert stitched is not None, "no stitched filer+volume trace"
+        assert stitched["duration_seconds"] >= 0.3
+        assert stitched["has_root"] and stitched["name"] == "filer.GET"
+        assert "slow" in stitched["reasons"]
+
+        # 2. the read-latency SLO pages and the burn-rate gauge rises
+        #    on the master's /metrics.
+        deadline = time.time() + 15
+        state = None
+        while time.time() < deadline and state != "page":
+            slo = _get_json(f"{base}/cluster/slo")
+            state = slo["objectives"]["read_p99_ms"]["state"]
+            time.sleep(0.2)
+        assert state == "page", slo
+        assert slo["objectives"]["read_p99_ms"]["burn_rates"]["5m"] \
+            > 14.4
+        fams = parse_exposition(_get(f"{base}/metrics").decode())
+        vals = [v for labels, v in fams["seaweed_slo_burn_rate"]
+                if labels == {"slo": "read_p99_ms", "window": "5m"}]
+        assert vals and vals[0] > 14.4
+
+        # ... and cluster.check folds the paging objective in as a
+        # problem.
+        env, out = _env(master)
+        with pytest.raises(ShellError, match="problems found"):
+            run_cluster_command(env, "cluster.check")
+        assert "slo read_p99_ms: page" in out.getvalue()
+
+        # 3. profiling the faulted server FROM THE MASTER returns
+        #    non-empty collapsed stacks while reads are in flight.
+        stop = threading.Event()
+
+        def _load():
+            while not stop.is_set():
+                try:
+                    _get(f"http://{filer.url}/obs/blob.bin")
+                except Exception:
+                    return
+        t = threading.Thread(target=_load)
+        t.start()
+        try:
+            text = _get(f"{base}/cluster/profile"
+                        f"?node={vol.url}&seconds=0.5").decode()
+        finally:
+            stop.set()
+            t.join()
+        lines = [ln for ln in text.strip().splitlines() if ln]
+        assert lines, "profile proxy returned no stacks"
+        stack, count = lines[0].rsplit(" ", 1)
+        assert int(count) >= 1 and ":" in stack
+
+        # /debug/vars mirrors the degraded state master-side.
+        vz = _get_json(f"{base}/debug/vars")
+        assert vz["slo_state"] == "page"
+        assert vz["trace_collector"]["count"] >= 1
+    finally:
+        faults.clear()
+        filer.stop()
+        vol.stop()
+        master.stop()
