@@ -62,6 +62,7 @@ TRACE_TIMEOUT = 300      # tracing-overhead stage (CPU mini cluster)
 TELEMETRY_TIMEOUT = 300  # telemetry-overhead stage (CPU mini cluster)
 FAULT_TIMEOUT = 300      # fault-point-overhead stage (CPU mini cluster)
 PROFILE_TIMEOUT = 300    # profiler-overhead stage (CPU mini cluster)
+USAGE_TIMEOUT = 300      # usage-accounting-overhead stage (CPU mini cluster)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -240,6 +241,12 @@ def parent() -> None:
     rc, out = _run(["--child-profile-overhead"], _scrubbed_env(),
                    PROFILE_TIMEOUT)
     stage_platforms["profile"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Per-tenant usage-accounting tax on the same path — same design.
+    rc, out = _run(["--child-usage-overhead"], _scrubbed_env(),
+                   USAGE_TIMEOUT)
+    stage_platforms["usage"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1610,6 +1617,12 @@ elif sys.argv[2] == "profiler":
         @staticmethod
         def configure(enabled):
             _profiler.configure(enabled=enabled, hz=1.0)
+elif sys.argv[2] == "usage":
+    # on = every filer request folds a tenant/bucket counter row plus a
+    # latency-digest insert and a SpaceSaving offer under the
+    # collector's lock, and the volume server offers each needle read
+    # into its hot-key sketch; off = the module-level flag fast path.
+    from seaweedfs_tpu.cluster import usage as plane
 else:  # "faults": on = armed-but-inert spec, so every fault point in
     # the read path pays the real armed cost (dict lookup miss) while
     # injecting nothing; off = the disarmed single-flag fast path.
@@ -1828,6 +1841,32 @@ def child_profile_overhead() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_usage_overhead() -> None:
+    """Per-tenant usage-accounting tax on the cached-read path
+    (docs/observability.md "usage accounting & ranked reads").
+
+    Same paired-block harness as the other observability stages; the
+    stdin toggle flips ``usage.configure(enabled=...)`` on the server
+    process, so the difference is exactly the metering cost: one
+    counter-row fold + latency-digest insert + SpaceSaving offer on
+    the filer, and one hot-key sketch offer on the volume server, per
+    request. Acceptance (ISSUE 8): overhead < 5%."""
+    t_off, t_on = _measure_plane_overhead("usage")
+    overhead = (t_on - t_off) / t_off
+    res = {
+        "usage_overhead_pct": round(overhead * 100, 2),
+        "usage_read_us_off": round(t_off * 1e6, 1),
+        "usage_read_us_on": round(t_on * 1e6, 1),
+        "usage_overhead_ok": bool(overhead < 0.05),
+    }
+    log(f"usage stage: cached read {res['usage_read_us_off']}us "
+        f"off / {res['usage_read_us_on']}us on -> "
+        f"{res['usage_overhead_pct']}% overhead "
+        f"({'OK' if res['usage_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -1856,5 +1895,8 @@ if __name__ == "__main__":
     elif ("--child-profile-overhead" in sys.argv
           or "--profile-overhead" in sys.argv):
         child_profile_overhead()
+    elif ("--child-usage-overhead" in sys.argv
+          or "--usage-overhead" in sys.argv):
+        child_usage_overhead()
     else:
         parent()

@@ -47,5 +47,19 @@ if [ "$rc" -ne 0 ]; then
     echo "lint_gate: slo_smoke failed (exit $rc) — the SLO engine," \
          "profiler, or trace collector regressed; see" \
          "scripts/slo_smoke.sh" >&2
+    exit "$rc"
+fi
+
+# Traffic-accounting smoke (docs/observability.md): two authenticated
+# tenants drive zipfian S3 traffic through a mini cluster, then
+# /cluster/topk attribution, /cluster/usage accounting, and the
+# seaweed_tenant_* gauges are asserted end to end.
+bash scripts/usage_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: usage_smoke failed (exit $rc) — per-tenant" \
+         "accounting or the hot-key sketch regressed; see" \
+         "scripts/usage_smoke.sh" >&2
 fi
 exit "$rc"

@@ -10,7 +10,9 @@
 #   - any server's /debug/vars is missing or not well-formed JSON, or
 #   - the cluster observability plane is dark: /cluster/traces or
 #     /cluster/slo missing, seaweed_slo_burn_rate absent from the
-#     master's exposition, or /debug/profile returning no stacks.
+#     master's exposition, or /debug/profile returning no stacks, or
+#   - traffic accounting is dark: /cluster/usage or /cluster/topk
+#     missing, malformed, or never ingesting a source.
 #
 #   bash scripts/metrics_smoke.sh [portBase] [workdir]
 set -euo pipefail
@@ -181,6 +183,35 @@ print(f"/cluster/traces: ring={tr['ring_size']} "
       f"ingested={tr['ingested']}; /cluster/slo objectives: "
       + ", ".join(f"{k}={v['state']}" for k, v in objs.items()))
 EOF
+
+say "/cluster/usage and /cluster/topk must serve the accounting JSON"
+# the filer traffic above is anonymous (no S3 auth in this smoke) but
+# still metered; the volume server's sketch rides the 1s heartbeat, so
+# at least one source must land well inside the poll window.
+OK=0
+for _ in $(seq 1 30); do
+  curl -sf "http://$M/cluster/usage" -o "$WORK/usage.json" &&
+    curl -sf "http://$M/cluster/topk?n=8" -o "$WORK/topk.json" &&
+    python - "$WORK/usage.json" "$WORK/topk.json" <<'EOF' && OK=1 && break
+import json, sys
+usage = json.load(open(sys.argv[1], encoding="utf-8"))
+topk = json.load(open(sys.argv[2], encoding="utf-8"))
+for key in ("tenants", "totals", "sources"):
+    if key not in usage:
+        sys.exit(f"FAIL: /cluster/usage missing {key!r}")
+for key in ("top", "total", "capacity", "sources"):
+    if key not in topk:
+        sys.exit(f"FAIL: /cluster/topk missing {key!r}")
+if not usage["sources"] or topk["total"] < 1:
+    sys.exit(1)  # nothing ingested yet — keep polling
+print(f"/cluster/usage: tenants={sorted(usage['tenants'])} over "
+      f"{len(usage['sources'])} sources; /cluster/topk: "
+      f"{len(topk['top'])} keys, total={topk['total']}")
+EOF
+  sleep 0.5
+done
+[ "$OK" = 1 ] || { echo "FAIL: usage accounting never reached master"
+                   cat "$WORK/usage.json" 2>/dev/null; exit 1; }
 
 say "seaweed_slo_burn_rate must render as valid exposition"
 curl -sf "http://$M/metrics" -o "$WORK/metrics.txt"

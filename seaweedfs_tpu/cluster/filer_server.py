@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -32,6 +33,7 @@ from ..util import retry
 from ..util import tracing
 from ..util import varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
+from . import usage as usage_mod
 from .master import _grpc_port
 from .wdclient import MasterClient
 from ..util import tls as tls_mod
@@ -59,6 +61,12 @@ class FilerServer:
         #: Loaded at start and re-read on changes via the filer's own
         #: meta stream — empty when no conf exists.
         self.path_conf = path_conf_mod.PathConf()
+        #: Traffic accounting (usage plane): the filer has no tenant
+        #: auth, so rows land under "anonymous" with the bucket drawn
+        #: from /buckets/<name> paths; a pusher ships the cumulative
+        #: snapshot to the master (the filer does not heartbeat).
+        self.usage = usage_mod.UsageCollector("filer")
+        self._usage_pusher: Optional[usage_mod.UsagePusher] = None
         self._conf_stop = threading.Event()
         self._grpc_server = None
         self._http_server: Optional[ThreadingHTTPServer] = None
@@ -137,6 +145,8 @@ class FilerServer:
             # Slow/errored filer roots join the master's stitched view.
             tracing.configure_push(self.master_url, node=self.url,
                                    component="filer")
+            self._usage_pusher = usage_mod.UsagePusher(
+                self.usage, self.master_url, self.url).start()
         self._load_path_conf()
         t = threading.Thread(target=self._follow_path_conf,
                              daemon=True,
@@ -149,6 +159,8 @@ class FilerServer:
 
     def stop(self) -> None:
         self._conf_stop.set()
+        if self._usage_pusher is not None:
+            self._usage_pusher.stop()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5).wait(timeout=2)
         if self._http_server:
@@ -328,6 +340,15 @@ class _FilerServicer:
 # ------------- HTTP -------------
 
 
+def _bucket_of(path: str) -> str:
+    """Bucket attribution for usage rows: /buckets/<name>/... paths
+    (the S3 gateway's layout) map to <name>; everything else is ''."""
+    parts = path.strip("/").split("/")
+    if len(parts) >= 2 and parts[0] == "buckets":
+        return parts[1]
+    return ""
+
+
 def _parse_signatures(q: dict) -> tuple:
     """``signatures=12,34`` query param -> int tuple (the HTTP face of
     the rpc signatures field; non-numeric values are ignored)."""
@@ -384,8 +405,9 @@ def _make_http_handler(fs: FilerServer):
                 ).encode(), "text/plain; charset=utf-8")
                 return
             if u.path == "/debug/vars":
-                self._send(200, json.dumps(
-                    varz.payload("filer", fs.metrics)).encode())
+                self._send(200, json.dumps(varz.payload(
+                    "filer", fs.metrics,
+                    extra={"usage": fs.usage.to_payload()})).encode())
                 return
             dl = retry.deadline_from_headers(self.headers)
             if dl is not None and dl.expired():
@@ -393,8 +415,11 @@ def _make_http_handler(fs: FilerServer):
                 return
             path, q = self._path()
             fs.metrics.counter("request_total", method="GET").inc()
+            t0 = time.perf_counter()
             entry = fs.filer.find_entry(path)
             if entry is None:
+                fs.usage.record("anonymous", _bucket_of(path),
+                                error=True, key=path)
                 self._err(404, f"{path} not found")
                 return
             if entry.is_dir:
@@ -433,6 +458,9 @@ def _make_http_handler(fs: FilerServer):
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+            fs.usage.record("anonymous", _bucket_of(path),
+                            n_out=len(data),
+                            seconds=time.perf_counter() - t0, key=path)
 
         def do_HEAD(self):
             path, _ = self._path()
@@ -460,6 +488,7 @@ def _make_http_handler(fs: FilerServer):
         def _upload(self):
             path, q = self._path()
             fs.metrics.counter("request_total", method="PUT").inc()
+            t0 = time.perf_counter()
             if q.get("mkdir") == "true" or self.path.rstrip("?").endswith(
                     "/") and not self._body_expected():
                 fs.filer.create_entry(Entry(
@@ -510,17 +539,24 @@ def _make_http_handler(fs: FilerServer):
                         append=q.get("op") == "append",
                         signatures=_parse_signatures(q))
             except FilerError as e:
+                fs.usage.record("anonymous", _bucket_of(path),
+                                n_in=len(body), error=True, key=path)
                 self._err(409, str(e))
                 return
             except ValueError as e:
                 # bad replication/ttl reaching the assign path (e.g. a
                 # typo'd filer.conf rule) must be an HTTP error, not an
                 # aborted connection
+                fs.usage.record("anonymous", _bucket_of(path),
+                                n_in=len(body), error=True, key=path)
                 self._err(400, str(e))
                 return
             self._send(201, json.dumps(
                 {"name": entry.name,
                  "size": total_size(entry.chunks)}).encode())
+            fs.usage.record("anonymous", _bucket_of(path),
+                            n_in=len(body),
+                            seconds=time.perf_counter() - t0, key=path)
 
         def _body_expected(self) -> bool:
             return int(self.headers.get("Content-Length", "0")) > 0
@@ -539,9 +575,12 @@ def _make_http_handler(fs: FilerServer):
                     fs.filer.delete_entry(path, recursive=recursive,
                                           signatures=sigs)
             except FilerError as e:
+                fs.usage.record("anonymous", _bucket_of(path),
+                                error=True)
                 self._err(404 if "not found" in str(e) else 409, str(e))
                 return
             self._send(204)
+            fs.usage.record("anonymous", _bucket_of(path))
 
     return tracing.instrument_http_handler(Handler, "filer")
 
@@ -612,6 +651,7 @@ def main(argv: list[str]) -> int:
     retry.configure_from(conf)
     faults_mod.configure_from(conf)
     profiler.configure_from(conf)
+    usage_mod.configure_from(conf)
     profiler.ensure_started()
     store = SqliteStore(args.db) if args.db else MemoryStore()
     filer = Filer(store)

@@ -41,6 +41,7 @@ from ..util import varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import ha as ha_mod
 from .ha import NotLeaderError
+from . import usage as usage_mod
 from .sequence import MemorySequencer
 from .telemetry import SloEngine
 from .topology import Topology, TopologyError, VolumeInfo
@@ -117,6 +118,11 @@ class MasterServer:
         self.trace_collector = tracing.TraceCollector(
             ring_size=trace_ring_size)
         self.slo = SloEngine(self.topology.telemetry)
+        #: Traffic accounting registry: volume servers ride the
+        #: heartbeat (Heartbeat.usage); gateways/filer POST the same
+        #: payload to /cluster/usage. Leader-only for the same reason
+        #: as traces/telemetry.
+        self.usage = usage_mod.ClusterUsage()
         self._pusher = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
@@ -229,6 +235,7 @@ class MasterServer:
             for url in dead:
                 glog.warning("master: data node %s missed heartbeats, "
                              "removed from topology", url)
+                self.usage.forget(url)
             if self.is_leader and tick % ttl_every == 0 \
                     and (self._ttl_thread is None or
                          not self._ttl_thread.is_alive()):
@@ -464,16 +471,49 @@ class MasterServer:
     def lookup(self, volume_id: int, collection: str = "") -> list[dict]:
         nodes = self.topology.lookup_volume(volume_id, collection)
         if not nodes:
-            # EC volumes answer lookups too (any node with a shard).
+            # EC volumes answer lookups too (any node with a shard);
+            # keep the shard list per node so clients and traffic.top
+            # can attribute EC reads.
             by_shard = self.topology.lookup_ec_volume(volume_id)
             seen: dict[str, dict] = {}
-            for node_list in by_shard.values():
+            shards: dict[str, list[int]] = {}
+            for sid, node_list in sorted(by_shard.items()):
                 for n in node_list:
-                    seen[n.url] = {"url": n.url,
-                                   "publicUrl": n.public_url or n.url}
-            return list(seen.values())
+                    seen[n.url] = n
+                    shards.setdefault(n.url, []).append(sid)
+            out = [{"url": n.url,
+                    "publicUrl": n.public_url or n.url,
+                    "shards": shards[n.url]}
+                   for n in self._rank_replicas(
+                       list(seen.values()), volume_id)]
+            return out
         return [{"url": n.url, "publicUrl": n.public_url or n.url}
-                for n in nodes]
+                for n in self._rank_replicas(nodes, volume_id)]
+
+    def _rank_replicas(self, nodes: list, volume_id: int) -> list:
+        """Telemetry-ranked read routing: healthy nodes first (then
+        degraded, unhealthy last), and within a tier by health score
+        plus a chunk-cache-warmth bonus for this volume — so clients
+        that try locations in order hit the warm healthy replica and
+        only fall through to a faulted node at the tail. With no
+        telemetry ingested every node scores 100/healthy and the
+        topology's deterministic order is preserved (the sort is
+        stable)."""
+        if len(nodes) < 2:
+            return nodes
+        tele = self.topology.telemetry
+        pulse = self.topology.pulse_seconds
+        tiers = {"healthy": 0, "degraded": 1, "unhealthy": 2}
+        ranked = []
+        for i, n in enumerate(nodes):
+            h = tele.health(n.url, n.last_seen, pulse)
+            warmth = tele.node_volumes(n.url).get(
+                volume_id, {}).get("cache_hit_ratio", 0.0)
+            key = (tiers.get(h["verdict"], 2),
+                   -(h["score"] + 25.0 * warmth), i)
+            ranked.append((key, n))
+        ranked.sort(key=lambda kn: kn[0])
+        return [n for _key, n in ranked]
 
 
 class _MasterServicer:
@@ -508,6 +548,8 @@ class _MasterServicer:
             if hb.HasField("telemetry"):
                 ms.topology.telemetry.ingest(url, hb.telemetry,
                                              metrics=ms.metrics)
+            if hb.HasField("usage"):
+                ms.usage.ingest_proto(url, hb.usage)
             if hb.max_file_key:
                 ms.sequencer.set_max(hb.max_file_key)
             yield master_pb2.HeartbeatResponse(
@@ -549,7 +591,8 @@ class _MasterServicer:
                 entry.error = f"volume {vid} not found"
             for loc in locs:
                 entry.locations.add(url=loc["url"],
-                                    public_url=loc["publicUrl"])
+                                    public_url=loc["publicUrl"],
+                                    shards=loc.get("shards", ()))
         return resp
 
     def LookupEcVolume(self, request, context):
@@ -713,6 +756,7 @@ def _make_http_handler(ms: MasterServer):
                 elif u.path == "/metrics":
                     body = (ms.metrics.render()
                             + ms.slo.metrics.render()
+                            + ms.usage.metrics.render()
                             + tracing.METRICS.render()
                             + retry.METRICS.render()).encode()
                     self.send_response(200)
@@ -739,6 +783,17 @@ def _make_http_handler(ms: MasterServer):
                         return
                     self._json(ms.trace_collector.payload(
                         int(q["limit"]) if q.get("limit") else None))
+                elif u.path == "/cluster/usage":
+                    # Usage lands on the leader (heartbeats + gateway
+                    # pushes go there), so read from there.
+                    if self._proxy_to_leader():
+                        return
+                    self._json(ms.usage.to_map())
+                elif u.path == "/cluster/topk":
+                    if self._proxy_to_leader():
+                        return
+                    self._json(ms.usage.topk_map(
+                        int(q.get("n", 32))))
                 elif u.path == "/cluster/slo":
                     if self._proxy_to_leader():
                         return
@@ -833,6 +888,21 @@ def _make_http_handler(ms: MasterServer):
                     self._json({"ok": True})
                 except (ValueError, OSError) as e:
                     self._json({"error": str(e)}, 400)
+            elif u.path == "/cluster/usage":
+                # Accounting sink for ingresses that do not heartbeat
+                # (S3/WebDAV/filer push their cumulative snapshots
+                # here; usage.UsagePusher).
+                if self._proxy_to_leader():
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    source = str(payload.get("source", "") or
+                                 self.client_address[0])
+                    ms.usage.ingest(source, payload)
+                    self._json({"ok": True})
+                except (ValueError, OSError) as e:
+                    self._json({"error": str(e)}, 400)
             elif u.path == "/vol/grow":
                 if self._proxy_to_leader():
                     return
@@ -879,6 +949,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     retry.configure_from(conf)
     faults_mod.configure_from(conf)
     profiler.configure_from(conf)
+    usage_mod.configure_from(conf)
     profiler.ensure_started()
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,

@@ -41,6 +41,7 @@ from ..storage.volume import dat_path, idx_path
 from ..util import faults, glog, profiler, retry, security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from . import telemetry as telemetry_mod
+from . import usage as usage_mod
 from .master import _grpc_port
 from ..util import tls as tls_mod
 
@@ -116,6 +117,10 @@ class VolumeServer:
         #: Per-volume hot stats (ops, bytes, latency digests); a
         #: compact snapshot rides every heartbeat to the master.
         self.telemetry = telemetry_mod.TelemetryCollector()
+        #: Per-needle hot-key accounting (usage plane): read fids feed
+        #: a SpaceSaving sketch that rides the heartbeat too, so the
+        #: master's /cluster/topk can name hot objects per volume.
+        self.usage = usage_mod.UsageCollector("volume")
         self.volume_size_limit = 30 * 1024 ** 3
         self._channels: dict[str, object] = {}
         self._grpc_server = None
@@ -283,6 +288,8 @@ class VolumeServer:
             hb.telemetry.CopyFrom(self.telemetry.snapshot(
                 cache_counts=self.chunk_cache.per_volume_counts(),
                 collections=collections))
+        if usage_mod.enabled():
+            hb.usage.CopyFrom(self.usage.snapshot())
         return hb
 
     def _heartbeat_loop(self) -> None:
@@ -988,14 +995,17 @@ def _make_http_handler(vs: VolumeServer):
                 self._json(varz.payload(
                     "volume", vs.metrics,
                     extra={"telemetry": vs.telemetry.to_map(),
-                           "cache": vs.chunk_cache.stats()}))
+                           "cache": vs.chunk_cache.stats(),
+                           "usage": vs.usage.to_payload()}))
                 return
             t0 = time.perf_counter()
             vid = None
+            fid_key = ""
             n_read = 0
             err = False
             try:
                 vid, fid, q = self._parse_fid()
+                fid_key = str(fid)
                 data = vs.read_bytes(vid, fid, q.get("collection", ""))
                 n_read = len(data)
                 mime = ""
@@ -1029,6 +1039,7 @@ def _make_http_handler(vs: VolumeServer):
                 vs.metrics.histogram("read_seconds").observe(dt)
                 if vid is not None:
                     vs.telemetry.record_read(vid, n_read, dt, error=err)
+                    vs.usage.record_key(fid_key, volume=vid)
 
         def do_HEAD(self):
             try:
@@ -1155,6 +1166,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     tls_mod.install_from_config(conf)
     tracing.configure_from(conf)
     telemetry_mod.configure_from(conf)
+    usage_mod.configure_from(conf)
     retry.configure_from(conf)
     faults.configure_from(conf)
     profiler.configure_from(conf)

@@ -124,12 +124,14 @@ class LocalCluster:
         if self.with_s3:
             self.procs["s3"] = _spawn(
                 ["s3", "-port", str(self.port_base + 300),
-                 "-filer", self.filer_url] + gwsec,
+                 "-filer", self.filer_url,
+                 "-master", self.master_urls[0]] + gwsec,
                 self.base / "s3.log")
         if self.with_webdav:
             self.procs["webdav"] = _spawn(
                 ["webdav", "-port", str(self.port_base + 400),
-                 "-filer", self.filer_url] + gwsec,
+                 "-filer", self.filer_url,
+                 "-master", self.master_urls[0]] + gwsec,
                 self.base / "webdav.log")
         self._write_manifest()
         return self

@@ -25,6 +25,7 @@ import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
 from ..util import glog
@@ -81,11 +82,18 @@ def _iso(ts: float) -> str:
 class S3Gateway:
     def __init__(self, filer_url: str, ip: str = "127.0.0.1",
                  port: int = 8333,
-                 identities: Optional[list[Identity]] = None):
+                 identities: Optional[list[Identity]] = None,
+                 master_url: str = ""):
         self.filer = FilerClient(filer_url)
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
+        #: Per-tenant traffic accounting (tenant = the SigV4 identity
+        #: name; "anonymous" on an open gateway). Pushed to the master
+        #: when one is configured — the gateway does not heartbeat.
+        self.master_url = master_url
+        self.usage = usage_mod.UsageCollector("s3")
+        self._usage_pusher: Optional[usage_mod.UsagePusher] = None
         #: identities passed explicitly (-config file) are static; with
         #: none, the gateway follows the filer-stored config and
         #: reloads it live (the reference's s3.configure flow)
@@ -181,12 +189,17 @@ class S3Gateway:
             target=self._http_server.serve_forever, daemon=True,
             name=f"s3-{self.port}")
         self._thread.start()
+        if self.master_url:
+            self._usage_pusher = usage_mod.UsagePusher(
+                self.usage, self.master_url, f"s3@{self.url}").start()
         glog.info("s3 gateway at %s -> filer %s", self.url,
                   self.filer.filer_url)
         return self
 
     def stop(self) -> None:
         self._conf_stop.set()
+        if self._usage_pusher is not None:
+            self._usage_pusher.stop()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()
@@ -197,6 +210,16 @@ class S3Gateway:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def account(self, ident, bucket: str, key: str, *,
+                n_in: int = 0, n_out: int = 0, seconds: float = 0.0,
+                error: bool = False) -> None:
+        """One usage row per request; object keys feed the hot-key
+        sketch as ``bucket/key`` so /cluster/topk can attribute them."""
+        self.usage.record(
+            ident.name if ident is not None else "anonymous", bucket,
+            n_in=n_in, n_out=n_out, seconds=seconds, error=error,
+            key=f"{bucket}/{key}" if bucket and key else "")
 
     # ---- bucket ops ----
 
@@ -590,7 +613,9 @@ def _make_handler(gw: S3Gateway):
                 import json
 
                 self._send(200, json.dumps(varz.payload(
-                    "s3", gw.metrics)).encode(), "application/json")
+                    "s3", gw.metrics,
+                    extra={"usage": gw.usage.to_payload()})).encode(),
+                    "application/json")
                 return
             if u.path == "/debug/profile":
                 q = dict(urllib.parse.parse_qsl(u.query))
@@ -601,6 +626,10 @@ def _make_handler(gw: S3Gateway):
                 return
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="GET").inc()
+            t0 = time.perf_counter()
+            ident = None
+            n_out = 0
+            err = False
             try:
                 ident = self._auth(b"", "Read" if bucket else "", bucket)
                 if not bucket:
@@ -622,6 +651,7 @@ def _make_handler(gw: S3Gateway):
                             f"bytes {offset}-{offset + length - 1}" \
                             f"/{size}"
                     data = gw.get_object(bucket, key, offset, length)
+                    n_out = len(data)
                     extra["ETag"] = f'"{_etag(entry)}"'
                     extra["Last-Modified"] = time.strftime(
                         "%a, %d %b %Y %H:%M:%S GMT",
@@ -630,12 +660,18 @@ def _make_handler(gw: S3Gateway):
                                entry.attributes.mime
                                or "application/octet-stream", extra)
             except Exception as e:
+                err = True
                 self._fail(e)
+            finally:
+                gw.account(ident, bucket, key, n_out=n_out,
+                           seconds=time.perf_counter() - t0, error=err)
 
         def do_HEAD(self):
             bucket, key, q, _ = self._split()
+            ident = None
+            err = False
             try:
-                self._auth(b"", "Read", bucket)
+                ident = self._auth(b"", "Read", bucket)
                 if not key:
                     gw._require_bucket(bucket)
                     self._send(200)
@@ -648,12 +684,18 @@ def _make_handler(gw: S3Gateway):
                             str(entry.attributes.file_size),
                             "ETag": f'"{_etag(entry)}"'})
             except Exception as e:
+                err = True
                 self._fail(e)
+            finally:
+                gw.account(ident, bucket, "", error=err)
 
         def do_PUT(self):
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="PUT").inc()
             body = self._body()
+            t0 = time.perf_counter()
+            ident = None
+            err = False
             try:
                 ident = self._auth(body, "Write" if key else "Admin",
                                    bucket)
@@ -682,13 +724,19 @@ def _make_handler(gw: S3Gateway):
                         self.headers.get("Content-Type", ""))
                     self._send(200, b"", extra={"ETag": f'"{etag}"'})
             except Exception as e:
+                err = True
                 self._fail(e)
+            finally:
+                gw.account(ident, bucket, key, n_in=len(body),
+                           seconds=time.perf_counter() - t0, error=err)
 
         def do_POST(self):
             bucket, key, q, _ = self._split()
             body = self._body()
+            ident = None
+            err = False
             try:
-                self._auth(body, "Write", bucket)
+                ident = self._auth(body, "Write", bucket)
                 if "uploads" in q:
                     self._send(200, gw.initiate_multipart(bucket, key))
                 elif "uploadId" in q:
@@ -698,13 +746,20 @@ def _make_handler(gw: S3Gateway):
                     raise S3Error("InvalidArgument",
                                   "unsupported POST")
             except Exception as e:
+                err = True
                 self._fail(e)
+            finally:
+                gw.account(ident, bucket, "", n_in=len(body),
+                           error=err)
 
         def do_DELETE(self):
             bucket, key, q, _ = self._split()
             gw.metrics.counter("request_total", method="DELETE").inc()
+            ident = None
+            err = False
             try:
-                self._auth(b"", "Write" if key else "Admin", bucket)
+                ident = self._auth(b"", "Write" if key else "Admin",
+                                   bucket)
                 if "uploadId" in q:
                     gw.abort_multipart(q["uploadId"], bucket)
                     self._send(204)
@@ -715,7 +770,10 @@ def _make_handler(gw: S3Gateway):
                     gw.delete_object(bucket, key)
                     self._send(204)
             except Exception as e:
+                err = True
                 self._fail(e)
+            finally:
+                gw.account(ident, bucket, "", error=err)
 
     return tracing.instrument_http_handler(Handler, "s3")
 
@@ -750,6 +808,8 @@ def main(argv: list[str]) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=8333)
     p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-master", default="",
+                   help="master url to push usage accounting to")
     p.add_argument("-config", default="",
                    help="identities JSON (empty = open access)")
     from ..util import tls as tls_mod
@@ -758,7 +818,7 @@ def main(argv: list[str]) -> int:
     tls_mod.install_from_flag(args)
     idents = load_identities(args.config) if args.config else None
     gw = S3Gateway(args.filer, ip=args.ip, port=args.port,
-                   identities=idents).start()
+                   identities=idents, master_url=args.master).start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -9,12 +9,14 @@ golang.org/x/net/webdav handler exposes over its filer FS adapter.
 from __future__ import annotations
 
 import threading
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..cache import global_chunk_cache
+from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..util import glog
 from ..util import tracing
@@ -41,12 +43,18 @@ def _rfc1123(ts: float) -> str:
 
 class WebDavServer:
     def __init__(self, filer_url: str, ip: str = "127.0.0.1",
-                 port: int = 7333, root: str = "/"):
+                 port: int = 7333, root: str = "/",
+                 master_url: str = ""):
         self.filer = FilerClient(filer_url)
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
         self.root = root.rstrip("/")
+        self.master_url = master_url
+        # DAV has no auth layer, so all traffic is the anonymous
+        # tenant; the hot-key sketch still attributes paths.
+        self.usage = usage_mod.UsageCollector("webdav")
+        self._usage_pusher: Optional[usage_mod.UsagePusher] = None
         self._http_server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -57,11 +65,17 @@ class WebDavServer:
             target=self._http_server.serve_forever, daemon=True,
             name=f"webdav-{self.port}")
         self._thread.start()
+        if self.master_url:
+            self._usage_pusher = usage_mod.UsagePusher(
+                self.usage, self.master_url,
+                f"webdav@{self.url}").start()
         glog.info("webdav at %s -> filer %s", self.url,
                   self.filer.filer_url)
         return self
 
     def stop(self) -> None:
+        if self._usage_pusher:
+            self._usage_pusher.stop()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()
@@ -123,6 +137,15 @@ def _make_handler(dav: WebDavServer):
                 urllib.parse.urlsplit(self.path).path)
             return p if p == "/" else p.rstrip("/")
 
+        def _account(self, path: str, *, n_in: int = 0,
+                     n_out: int = 0, seconds: float = 0.0,
+                     error: bool = False) -> None:
+            parts = path.strip("/").split("/")
+            dav.usage.record(
+                "anonymous", parts[0] if parts else "",
+                n_in=n_in, n_out=n_out, seconds=seconds,
+                error=error, key=dav.fpath(path))
+
         def _lookup(self, path: str):
             fp = dav.fpath(path)
             if fp == "/":
@@ -171,7 +194,9 @@ def _make_handler(dav: WebDavServer):
 
                 from ..util import varz
                 self._send(200, json.dumps(varz.payload(
-                    "webdav")).encode(), "application/json")
+                    "webdav",
+                    extra={"usage": dav.usage.to_payload()},
+                )).encode(), "application/json")
                 return
             if path == "/debug/profile":
                 from ..util import profiler
@@ -182,8 +207,10 @@ def _make_handler(dav: WebDavServer):
                     hz=float(q.get("hz", profiler.DEFAULT_BURST_HZ))
                 ).encode(), "text/plain; charset=utf-8")
                 return
+            t0 = time.perf_counter()
             entry = self._lookup(path)
             if entry is None:
+                self._account(path, error=True)
                 self._send(404)
                 return
             if entry.is_directory:
@@ -198,9 +225,12 @@ def _make_handler(dav: WebDavServer):
                 try:
                     data = dav.filer.get_data(dav.fpath(path))
                 except FilerClientError:
+                    self._account(path, error=True)
                     self._send(404)
                     return
                 cache.put(ckey, data)
+            self._account(path, n_out=len(data),
+                          seconds=time.perf_counter() - t0)
             self._send(200, data, entry.attributes.mime
                        or "application/octet-stream")
 
@@ -218,13 +248,17 @@ def _make_handler(dav: WebDavServer):
             n = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(n) if n else b""
             path = self._dav_path()
+            t0 = time.perf_counter()
             try:
                 dav.filer.put_data(
                     dav.fpath(path), body,
                     mime=self.headers.get("Content-Type", ""))
             except FilerClientError as e:
+                self._account(path, n_in=len(body), error=True)
                 self._send(409, str(e).encode(), "text/plain")
                 return
+            self._account(path, n_in=len(body),
+                          seconds=time.perf_counter() - t0)
             self._send(201)
 
         def do_MKCOL(self):
@@ -241,13 +275,16 @@ def _make_handler(dav: WebDavServer):
         def do_DELETE(self):
             path = self._dav_path()
             if self._lookup(path) is None:
+                self._account(path, error=True)
                 self._send(404)
                 return
             try:
                 dav.filer.delete_data(dav.fpath(path), recursive=True)
             except FilerClientError as e:
+                self._account(path, error=True)
                 self._send(409, str(e).encode(), "text/plain")
                 return
+            self._account(path)
             self._send(204)
 
         def _destination(self) -> Optional[str]:
@@ -305,12 +342,15 @@ def main(argv: list[str]) -> int:
     p.add_argument("-filer", default="127.0.0.1:8888")
     p.add_argument("-root", default="/",
                    help="filer directory served as the DAV root")
+    p.add_argument("-master", default="",
+                   help="master url to push usage snapshots to")
     from ..util import tls as tls_mod
     tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
     tls_mod.install_from_flag(args)
     srv = WebDavServer(args.filer, ip=args.ip, port=args.port,
-                       root=args.root).start()
+                       root=args.root,
+                       master_url=args.master).start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

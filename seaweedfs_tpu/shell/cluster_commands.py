@@ -1961,6 +1961,90 @@ def cmd_volume_heatmap(env: ClusterEnv, argv: list[str]) -> None:
                 env.println(f"  {url:<21} {s['samples']:>7}  {leaf}")
 
 
+def _fmt_bytes(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024 or unit == "TiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024
+    return f"{n}B"
+
+
+@cluster_command("traffic.top")
+def cmd_traffic_top(env: ClusterEnv, argv: list[str]) -> None:
+    """Hottest object keys cluster-wide from the master's merged
+    SpaceSaving sketches (/cluster/topk): count is an overestimate by
+    at most the shown ±error, attributed to the recording tenant and
+    volume where known."""
+    p = _parser("traffic.top")
+    p.add_argument("-n", type=int, default=20,
+                   help="keys to show (hottest first)")
+    args = p.parse_args(argv)
+    doc = env._master_http(f"/cluster/topk?n={max(1, args.n)}")
+    top = doc.get("top", [])
+    if not top:
+        env.println("traffic.top: no usage ingested yet (gateways "
+                    "push snapshots, volume servers ride heartbeats)")
+        return
+    env.println(f"traffic.top: {doc.get('total', 0)} keyed requests "
+                f"over {doc.get('sources', 0)} sources "
+                f"(sketch capacity {doc.get('capacity')})")
+    env.println(f"{'count':>9} {'±err':>6} {'tenant':<14} "
+                f"{'volume':>6} key")
+    for r in top:
+        env.println(
+            f"{r['count']:>9} {r.get('error', 0):>6} "
+            f"{r.get('tenant') or '-':<14} "
+            f"{r.get('volume') or '-':>6} {r['key']}")
+
+
+@cluster_command("tenant.usage")
+def cmd_tenant_usage(env: ClusterEnv, argv: list[str]) -> None:
+    """Per-tenant traffic accounting from the master's merged usage
+    plane (/cluster/usage): requests, bytes in/out, errors and request
+    latency quantiles, broken down per bucket."""
+    p = _parser("tenant.usage")
+    p.add_argument("-tenant", default="",
+                   help="show only this tenant")
+    args = p.parse_args(argv)
+    doc = env._master_http("/cluster/usage")
+    tenants = doc.get("tenants", {})
+    if args.tenant:
+        tenants = {k: v for k, v in tenants.items()
+                   if k == args.tenant}
+    if not tenants:
+        env.println("tenant.usage: no usage ingested yet"
+                    + (f" for tenant {args.tenant!r}"
+                       if args.tenant else ""))
+        return
+    for tenant in sorted(tenants,
+                         key=lambda t: -tenants[t]["requests"]):
+        t = tenants[tenant]
+        env.println(
+            f"{tenant}: {t['requests']} requests "
+            f"in={_fmt_bytes(t['bytes_in'])} "
+            f"out={_fmt_bytes(t['bytes_out'])} "
+            f"errors={t['errors']}")
+        for bucket in sorted(t.get("buckets", {})):
+            b = t["buckets"][bucket]
+            lat = b.get("latency") or {}
+            env.println(
+                f"  {bucket:<16} {b['requests']:>8} req "
+                f"in={_fmt_bytes(b['bytes_in']):>9} "
+                f"out={_fmt_bytes(b['bytes_out']):>9} "
+                f"err={b['errors']}"
+                + (f" p50={_fmt_ms(lat.get('p50'))}ms"
+                   f" p99={_fmt_ms(lat.get('p99'))}ms"
+                   if lat else ""))
+    totals = doc.get("totals", {})
+    env.println(
+        f"total: {totals.get('requests', 0)} requests "
+        f"in={_fmt_bytes(totals.get('bytes_in', 0))} "
+        f"out={_fmt_bytes(totals.get('bytes_out', 0))} "
+        f"errors={totals.get('errors', 0)} "
+        f"(sources: {', '.join(sorted(doc.get('sources', {})))})")
+
+
 def run_cluster_command(env: ClusterEnv, line: str) -> None:
     parts = shlex.split(line)
     if not parts:

@@ -387,6 +387,19 @@ def cmd_cache_status(env: CommandEnv, argv: list[str]) -> None:
     env.println(f"  evictions={st['evictions']} "
                 f"admission_rejects={st['admission_rejects']} "
                 f"ttl_seconds={st['ttl_seconds']}")
+    per_vol = global_chunk_cache().per_volume_counts()
+    if per_vol:
+        def ratio(c: dict) -> float:
+            looked = c.get("hits", 0) + c.get("misses", 0)
+            return c.get("hits", 0) / looked if looked else 0.0
+        env.println("  per volume (hit ratio desc):")
+        for vid in sorted(per_vol, key=lambda v: -ratio(per_vol[v])):
+            c = per_vol[vid]
+            env.println(
+                f"    volume {vid}: hits={c.get('hits', 0)} "
+                f"misses={c.get('misses', 0)} "
+                f"rejects={c.get('rejects', 0)} "
+                f"hit_ratio={ratio(c):.3f}")
     if invalidation.events:
         pairs = " ".join(f"{k}={v}"
                          for k, v in sorted(invalidation.events.items()))

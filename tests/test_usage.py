@@ -1,0 +1,444 @@
+"""Traffic accounting plane unit + property tests: the SpaceSaving
+sketch's error bound and merge algebra, the per-process collector's
+wire round-trips, the master registry's replacement semantics and
+cardinality-capped gauges, and the telemetry-ranked lookup."""
+
+import json
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import usage
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.topology import VolumeInfo
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.util.stats import Digest
+
+from conftest import parse_exposition
+
+
+# ------------- SpaceSaving sketch -------------
+
+def _zipf_stream(rng, n_items, n_keys=500, s=1.3):
+    weights = [1.0 / (k + 1) ** s for k in range(n_keys)]
+    return rng.choices([f"k{k}" for k in range(n_keys)],
+                       weights=weights, k=n_items)
+
+
+def _true_counts(stream):
+    out = {}
+    for k in stream:
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def test_spacesaving_error_bound_on_zipf_stream():
+    rng = random.Random(7)
+    stream = _zipf_stream(rng, 20_000)
+    true = _true_counts(stream)
+    s = usage.SpaceSaving(capacity=50)
+    for k in stream:
+        s.offer(k)
+    assert s.total == len(stream)
+    # every reported key: count - error <= true <= count, and the
+    # error never exceeds the classic total/capacity bound
+    for r in s.entries():
+        t = true[r["key"]]
+        assert r["count"] - r["error"] <= t <= r["count"]
+        assert r["error"] <= len(stream) // 50
+    # the genuinely heavy keys survive eviction
+    top_true = sorted(true, key=lambda k: -true[k])[:10]
+    kept = {r["key"] for r in s.entries()}
+    assert set(top_true) <= kept
+
+
+def test_spacesaving_merge_is_order_insensitive():
+    rng = random.Random(21)
+    stream = _zipf_stream(rng, 30_000)
+    true = _true_counts(stream)
+    shards = [stream[i::3] for i in range(3)]
+    sketches = []
+    for part in shards:
+        s = usage.SpaceSaving(capacity=64)
+        for k in part:
+            s.offer(k)
+        sketches.append(s)
+
+    def merged(order):
+        m = usage.SpaceSaving(capacity=64)
+        for i in order:
+            m.merge(sketches[i])
+        return m
+
+    results = [merged(o) for o in ((0, 1, 2), (2, 0, 1), (1, 2, 0))]
+    for m in results:
+        assert m.total == len(stream)
+        # the bound survives distribution + merge
+        for r in m.entries():
+            t = true[r["key"]]
+            assert r["count"] - r["error"] <= t <= r["count"]
+    # order-insensitive where it matters: every fold order reports the
+    # same heavy hitters, in the same rank order (tail entries below
+    # the error floor may differ — that is the sketch's contract)
+    heavy = [r["key"] for r in results[0].entries()[:10]]
+    assert heavy == sorted(true, key=lambda k: -true[k])[:10]
+    for m in results[1:]:
+        assert [r["key"] for r in m.entries()[:10]] == heavy
+
+
+def test_spacesaving_merge_exact_under_capacity():
+    # union cardinality below capacity -> merge is exact summation
+    a = usage.SpaceSaving(capacity=32)
+    b = usage.SpaceSaving(capacity=32)
+    for _ in range(5):
+        a.offer("x")
+    for _ in range(3):
+        a.offer("y")
+    for _ in range(7):
+        b.offer("x")
+    for _ in range(2):
+        b.offer("z", tenant="acme", volume=4)
+    a.merge(b)
+    est = {r["key"]: r for r in a.entries()}
+    assert est["x"]["count"] == 12 and est["x"]["error"] == 0
+    assert est["y"]["count"] == 3 and est["z"]["count"] == 2
+    assert est["z"]["tenant"] == "acme" and est["z"]["volume"] == 4
+    assert a.total == 17
+
+
+def test_spacesaving_round_trips():
+    s = usage.SpaceSaving(capacity=8)
+    rng = random.Random(3)
+    for k in _zipf_stream(rng, 2_000, n_keys=40):
+        s.offer(k, tenant="t1", volume=2)
+    # JSON dict round-trip
+    d = json.loads(json.dumps(s.to_dict()))
+    assert usage.SpaceSaving.from_dict(d).to_dict() == s.to_dict()
+    # proto round-trip via UsageSnapshot
+    snap = master_pb2.UsageSnapshot()
+    s.fill_proto(snap)
+    wire = master_pb2.UsageSnapshot.FromString(snap.SerializeToString())
+    assert usage.SpaceSaving.from_proto(wire).to_dict() == s.to_dict()
+
+
+# ------------- UsageCollector -------------
+
+def test_collector_records_and_snapshots():
+    c = usage.UsageCollector("s3")
+    c.record("acme", "photos", n_in=100, seconds=0.010,
+             key="photos/a.jpg")
+    c.record("acme", "photos", n_out=5000, seconds=0.002,
+             key="photos/a.jpg")
+    c.record("", "photos", error=True)  # blank tenant -> anonymous
+    p = c.to_payload()
+    rows = {(r["tenant"], r["bucket"]): r for r in p["tenants"]}
+    acme = rows[("acme", "photos")]
+    assert acme["requests"] == 2 and acme["bytes_in"] == 100
+    assert acme["bytes_out"] == 5000
+    assert Digest.from_dict(acme["latency"]).count == 2
+    assert rows[("anonymous", "photos")]["errors"] == 1
+    assert p["top_keys"][0]["key"] == "photos/a.jpg"
+    assert p["top_keys"][0]["count"] == 2
+    # proto snapshot carries the same state through the wire shape
+    snap = master_pb2.UsageSnapshot.FromString(
+        c.snapshot().SerializeToString())
+    back = usage.snapshot_to_payload(snap)
+    assert back["topk_total"] == p["topk_total"]
+    assert {(r["tenant"], r["bucket"]) for r in back["tenants"]} == \
+        set(rows)
+
+
+def test_collector_disabled_is_a_noop():
+    c = usage.UsageCollector("filer")
+    usage.configure(enabled=False)
+    try:
+        c.record("acme", "b", n_in=10, key="x")
+        c.record_key("1,abc", volume=1)
+        assert not usage.enabled()
+    finally:
+        usage.configure(enabled=True)
+    p = c.to_payload()
+    assert p["tenants"] == [] and p["top_keys"] == []
+
+
+def test_configure_from_config_section():
+    usage.configure_from({"usage": {"enabled": False,
+                                    "push_interval_seconds": 0.5}})
+    try:
+        assert not usage.enabled()
+        assert usage.push_interval() == 0.5
+    finally:
+        usage.configure(enabled=True,
+                        push_interval_seconds=usage.PUSH_INTERVAL)
+    # absent/malformed sections leave the flags alone
+    usage.configure_from({})
+    usage.configure_from({"usage": "nope"})
+    assert usage.enabled()
+
+
+# ------------- ClusterUsage (master side) -------------
+
+def _payload(component="s3", requests=10, key="b/k", tenant="acme",
+             bucket="b", lat=None):
+    r = {"tenant": tenant, "bucket": bucket, "requests": requests,
+         "bytes_in": 0, "bytes_out": requests * 100, "errors": 0}
+    if lat is not None:
+        d = Digest()
+        for x in lat:
+            d.add(x)
+        r["latency"] = d.to_dict()
+    return {"component": component, "window_ns": 1, "tenants": [r],
+            "top_keys": [{"key": key, "count": requests, "error": 0,
+                          "tenant": tenant, "volume": 0}],
+            "topk_total": requests, "topk_capacity": 64}
+
+
+def test_cluster_usage_replacement_never_double_counts():
+    now = [0.0]
+    cu = usage.ClusterUsage(clock=lambda: now[0])
+    cu.ingest("s3@a", _payload(requests=10, lat=[0.01] * 10))
+    # re-delivery of a GROWN cumulative snapshot replaces, not adds
+    cu.ingest("s3@a", _payload(requests=15, lat=[0.01] * 15))
+    cu.ingest("s3@a", _payload(requests=15, lat=[0.01] * 15))
+    doc = cu.to_map()
+    assert doc["tenants"]["acme"]["requests"] == 15
+    assert doc["totals"]["requests"] == 15
+    b = doc["tenants"]["acme"]["buckets"]["b"]
+    assert b["latency"]["count"] == 15 and "p99" in b["latency"]
+    assert doc["sources"]["s3@a"]["snapshots"] == 3
+    # a second source DOES add at read time
+    cu.ingest("filer@c", _payload(component="filer", requests=5))
+    doc = cu.to_map()
+    assert doc["tenants"]["acme"]["requests"] == 20
+    top = cu.topk_map(n=5)
+    assert top["top"][0]["key"] == "b/k"
+    assert top["top"][0]["count"] == 20
+    # restart (counter regression) is a plain reset for that source
+    cu.ingest("s3@a", _payload(requests=2))
+    assert cu.to_map()["tenants"]["acme"]["requests"] == 7
+    cu.forget("filer@c")
+    assert cu.to_map()["tenants"]["acme"]["requests"] == 2
+
+
+def test_cluster_usage_gauges_are_cardinality_capped():
+    cu = usage.ClusterUsage()
+    for i in range(usage.TENANT_GAUGE_CAP + 10):
+        cu.ingest(f"s3@{i}", _payload(tenant=f"tenant{i:03d}",
+                                      requests=1))
+    samples = parse_exposition(cu.metrics.render())
+    labels = {lbl["tenant"]
+              for lbl, _v in samples["seaweed_tenant_requests_total"]}
+    # first CAP tenants keep their name, the overflow folds to "other"
+    assert len(labels) == usage.TENANT_GAUGE_CAP + 1
+    assert "other" in labels
+    other = [v for lbl, v in samples["seaweed_tenant_requests_total"]
+             if lbl["tenant"] == "other"]
+    assert other == [10.0]
+
+
+# ------------- telemetry-ranked lookup -------------
+
+def _tele_snap(vid, read_ops=0, errors=0, hits=0, misses=0):
+    s = master_pb2.TelemetrySnapshot(window_ns=1_000_000_000)
+    s.volumes.add(volume_id=vid, read_ops=read_ops, errors=errors,
+                  cache_hits=hits, cache_misses=misses)
+    return s
+
+
+def test_lookup_ranks_warm_healthy_replicas_first():
+    ms = MasterServer(port=0, pulse_seconds=5.0, seed=1)
+    for url in ("h1:8080", "h2:8080", "h3:8080"):
+        ms.topology.register_heartbeat(
+            url, max_volume_count=8,
+            volumes=[VolumeInfo(id=1, size=10)])
+    # no telemetry: topology order is preserved (stable sort)
+    assert [n["url"] for n in ms.lookup(1)] == \
+        ["h1:8080", "h2:8080", "h3:8080"]
+    tele = ms.topology.telemetry
+    # h1 errors hard -> degraded; h3 is warm for volume 1
+    tele.ingest("h1:8080", _tele_snap(1, read_ops=100, errors=60))
+    tele.ingest("h2:8080", _tele_snap(1, read_ops=100))
+    tele.ingest("h3:8080", _tele_snap(1, read_ops=100,
+                                      hits=95, misses=5))
+    urls = [n["url"] for n in ms.lookup(1)]
+    assert urls[0] == "h3:8080"      # healthy + warm cache
+    assert urls[-1] == "h1:8080"     # error-heavy node demoted
+
+
+def test_lookup_ec_fallback_reports_shards_ranked():
+    ms = MasterServer(port=0, pulse_seconds=5.0, seed=1)
+    ms.topology.register_heartbeat(
+        "e1:8080", max_volume_count=8,
+        ec_shards=[("", 7, 0b0011)])
+    ms.topology.register_heartbeat(
+        "e2:8080", max_volume_count=8,
+        ec_shards=[("", 7, 0b1100)])
+    locs = ms.lookup(7)
+    by_url = {n["url"]: n["shards"] for n in locs}
+    assert by_url == {"e1:8080": [0, 1], "e2:8080": [2, 3]}
+    # a degraded shard holder drops to the tail
+    ms.topology.telemetry.ingest(
+        "e1:8080", _tele_snap(7, read_ops=100, errors=60))
+    ms.topology.telemetry.ingest("e2:8080", _tele_snap(7, read_ops=100))
+    assert [n["url"] for n in ms.lookup(7)] == ["e2:8080", "e1:8080"]
+
+
+# ------------- end-to-end mini-cluster -------------
+
+PULSE = 0.2
+
+
+def _get_json(url):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_usage_cluster_end_to_end(tmp_path):
+    """Two tenants drive zipfian S3 traffic through a replicated
+    mini-cluster: the master's /cluster/topk attributes the hot key to
+    the right tenant, /cluster/usage and the seaweed_tenant_* gauges
+    account both tenants, and once one replica is faulted, ranked
+    lookups demote it to the tail."""
+    import urllib.error
+    import urllib.request
+
+    from seaweedfs_tpu.cluster.filer_server import FilerServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.gateway.s3 import S3Gateway
+    from seaweedfs_tpu.gateway.s3_auth import (
+        Identity, sign_request_headers)
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.util import faults
+
+    from test_chaos_integration import _free_port_pair
+
+    usage.configure(push_interval_seconds=0.2)
+    master = MasterServer(port=_free_port_pair(),
+                          volume_size_limit_mb=64, pulse_seconds=PULSE,
+                          seed=11, default_replication="001",
+                          garbage_threshold=0).start()
+    vols = []
+    for i in range(2):
+        d = tmp_path / f"v{i}"
+        d.mkdir()
+        vols.append(VolumeServer(
+            Store([d], max_volumes=8), port=_free_port_pair(),
+            master_url=master.url, pulse_seconds=PULSE).start())
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < 2:
+        time.sleep(0.05)
+    assert len(master.topology.nodes) == 2
+    filer = FilerServer(Filer(), port=_free_port_pair(),
+                        master_url=master.url).start()
+    idents = [Identity(name="alice", access_key="AK1", secret_key="S1"),
+              Identity(name="bob", access_key="AK2", secret_key="S2")]
+    gw = S3Gateway(filer.url, port=_free_port_pair(),
+                   identities=idents, master_url=master.url).start()
+
+    def s3(method, path, body=b"", ak="AK1", sk="S1"):
+        url = f"http://{gw.url}{path}"
+        hdrs = sign_request_headers(method, url, {}, body, ak, sk)
+        req = urllib.request.Request(url, data=body or None,
+                                     method=method, headers=hdrs)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.read()
+
+    base = f"http://{master.url}"
+    try:
+        # --- zipfian two-tenant traffic: alice hammers one hot
+        # object, bob spreads a light tail over several keys.
+        s3("PUT", "/photos")
+        s3("PUT", "/photos/hot.bin", b"h" * 8192)
+        for _ in range(30):
+            assert s3("GET", "/photos/hot.bin") == b"h" * 8192
+        s3("PUT", "/logs", ak="AK2", sk="S2")
+        for i in range(5):
+            s3("PUT", f"/logs/l{i}.txt", b"l" * 128, ak="AK2",
+               sk="S2")
+            s3("GET", f"/logs/l{i}.txt", ak="AK2", sk="S2")
+
+        # --- the merged sketch attributes the hot key to alice, and
+        # volume-server fid keys (volume > 0) ride the heartbeat in.
+        deadline = time.time() + 15
+        top = None
+        while time.time() < deadline:
+            doc = _get_json(f"{base}/cluster/topk?n=50")
+            if doc["top"] and doc["top"][0]["key"] == \
+                    "photos/hot.bin" and \
+                    any(e["volume"] > 0 for e in doc["top"]):
+                top = doc
+                break
+            time.sleep(0.1)
+        assert top is not None, "hot key never surfaced on the master"
+        hot = top["top"][0]
+        assert hot["tenant"] == "alice"
+        assert hot["count"] - hot["error"] <= 31 <= hot["count"]
+
+        # --- per-tenant accounting and the capped gauges.
+        udoc = _get_json(f"{base}/cluster/usage")
+        alice = udoc["tenants"]["alice"]
+        bob = udoc["tenants"]["bob"]
+        assert alice["requests"] > bob["requests"]
+        assert alice["bytes_out"] >= 30 * 8192
+        assert "photos" in alice["buckets"]
+        assert alice["buckets"]["photos"]["latency"]["count"] > 0
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=10) as r:
+            fams = parse_exposition(r.read().decode())
+        tenants = {lbl["tenant"] for lbl, _v in
+                   fams["seaweed_tenant_requests_total"]}
+        assert {"alice", "bob"} <= tenants
+
+        # --- ranked reads: fault one replica of a replicated volume;
+        # its error-heavy telemetry demotes it to the lookup tail.
+        vid = next(v for v in range(1, master.topology.max_volume_id
+                                    + 1)
+                   if len(master.topology.lookup_volume(v)) == 2)
+        urls = [n["url"] for n in
+                _get_json(f"{base}/dir/lookup?volumeId={vid}")
+                ["locations"]]
+        victim, healthy = urls[0], urls[1]
+        # error#8 exhausts after 8 injections, all of which land on
+        # the victim because nothing else reads during this window
+        faults.inject("volume.read", "error#8")
+        for _ in range(10):
+            try:
+                urllib.request.urlopen(
+                    f"http://{victim}/{vid},00000000000000",
+                    timeout=10).read()
+            except urllib.error.HTTPError:
+                pass
+        deadline = time.time() + 15
+        ranked = None
+        while time.time() < deadline:
+            locs = _get_json(f"{base}/dir/lookup?volumeId={vid}")
+            got = [n["url"] for n in locs["locations"]]
+            if got == [healthy, victim]:
+                ranked = got
+                break
+            time.sleep(0.1)
+        assert ranked == [healthy, victim], \
+            f"faulted replica {victim} was not demoted"
+    finally:
+        faults.clear()
+        usage.configure(push_interval_seconds=usage.PUSH_INTERVAL)
+        gw.stop()
+        filer.stop()
+        for v in vols:
+            v.stop()
+        master.stop()
+
+
+def test_heartbeat_proto_carries_usage_and_shards():
+    hb = master_pb2.Heartbeat(ip="127.0.0.1", port=8080)
+    hb.usage.CopyFrom(usage.UsageCollector("volume").snapshot())
+    hb.usage.top_keys.add(key="1,ab01", count=3, volume=1)
+    wire = master_pb2.Heartbeat.FromString(hb.SerializeToString())
+    assert wire.HasField("usage")
+    assert wire.usage.top_keys[0].key == "1,ab01"
+    loc = master_pb2.Location(url="a:1", shards=[0, 3, 9])
+    assert list(master_pb2.Location.FromString(
+        loc.SerializeToString()).shards) == [0, 3, 9]
