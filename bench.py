@@ -63,6 +63,7 @@ TELEMETRY_TIMEOUT = 300  # telemetry-overhead stage (CPU mini cluster)
 FAULT_TIMEOUT = 300      # fault-point-overhead stage (CPU mini cluster)
 PROFILE_TIMEOUT = 300    # profiler-overhead stage (CPU mini cluster)
 USAGE_TIMEOUT = 300      # usage-accounting-overhead stage (CPU mini cluster)
+JOBS_TIMEOUT = 300       # maintenance-plane-overhead stage (CPU mini cluster)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -247,6 +248,12 @@ def parent() -> None:
     rc, out = _run(["--child-usage-overhead"], _scrubbed_env(),
                    USAGE_TIMEOUT)
     stage_platforms["usage"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Idle maintenance-plane tax on the same path — same design.
+    rc, out = _run(["--child-jobs-overhead"], _scrubbed_env(),
+                   JOBS_TIMEOUT)
+    stage_platforms["jobs"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1623,6 +1630,19 @@ elif sys.argv[2] == "usage":
     # collector's lock, and the volume server offers each needle read
     # into its hot-key sketch; off = the module-level flag fast path.
     from seaweedfs_tpu.cluster import usage as plane
+elif sys.argv[2] == "jobs":
+    # on = the maintenance plane idling: module switch armed (volume-
+    # server claim polls + heartbeat job_progress piggyback) plus the
+    # master's replication-policy loop ticking every pulse over live
+    # telemetry; nothing is ever submitted, so the difference is
+    # exactly the plane's idle tax on an unrelated read path.
+    from seaweedfs_tpu.cluster import jobs as _jobs
+    class plane:
+        @staticmethod
+        def configure(enabled):
+            _jobs.configure(enabled=enabled)
+            master.policy.enabled = enabled
+            master.policy.interval = 0.2
 else:  # "faults": on = armed-but-inert spec, so every fault point in
     # the read path pays the real armed cost (dict lookup miss) while
     # injecting nothing; off = the disarmed single-flag fast path.
@@ -1867,6 +1887,34 @@ def child_usage_overhead() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_jobs_overhead() -> None:
+    """Maintenance-plane tax on the cached-read path when the plane is
+    idle (docs/jobs.md).
+
+    Same paired-block harness as the observability stages; the stdin
+    toggle flips the ``[jobs]`` module switch plus the master's policy
+    loop (retuned to tick every pulse, far hotter than the production
+    15s default), so "on" pays the volume server's claim polls, the
+    heartbeat ``job_progress`` piggyback, and the policy evaluation
+    over live telemetry — with no job ever submitted. The difference
+    is exactly what an idle maintenance plane costs foreground reads.
+    Acceptance (ISSUE 9): overhead < 2%."""
+    t_off, t_on = _measure_plane_overhead("jobs")
+    overhead = (t_on - t_off) / t_off
+    res = {
+        "jobs_overhead_pct": round(overhead * 100, 2),
+        "jobs_read_us_off": round(t_off * 1e6, 1),
+        "jobs_read_us_on": round(t_on * 1e6, 1),
+        "jobs_overhead_ok": bool(overhead < 0.02),
+    }
+    log(f"jobs stage: cached read {res['jobs_read_us_off']}us "
+        f"off / {res['jobs_read_us_on']}us on -> "
+        f"{res['jobs_overhead_pct']}% overhead "
+        f"({'OK' if res['jobs_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -1898,5 +1946,8 @@ if __name__ == "__main__":
     elif ("--child-usage-overhead" in sys.argv
           or "--usage-overhead" in sys.argv):
         child_usage_overhead()
+    elif ("--child-jobs-overhead" in sys.argv
+          or "--jobs-overhead" in sys.argv):
+        child_jobs_overhead()
     else:
         parent()

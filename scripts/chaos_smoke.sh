@@ -15,6 +15,12 @@
 #   3. replica death — in-process mini-cluster (replication=010), one
 #                      replica holder killed between write and read;
 #                      reads must fail over and count a degraded read.
+#   4. worker death  — in-process mini-cluster; a volume server dies
+#      mid-sweep       holding a leased ec_encode job task; the lease
+#                      must expire, the task re-queue with the dead
+#                      worker excluded, and the surviving replica
+#                      holder must finish the sweep with shard files
+#                      sha256-identical to a single-host encode.
 #
 #   bash scripts/chaos_smoke.sh [portBase] [workdir]
 set -euo pipefail
@@ -151,6 +157,118 @@ degraded = retry.METRICS.counter("degraded_reads_total",
                                  stage="replica_failover").value
 assert degraded > 0, "failover read was not counted as degraded"
 print(f"read survived replica death, degraded_reads_total={degraded}: OK")
+
+mc.close()
+for vs in servers:
+    try:
+        vs.stop()
+    except Exception:
+        pass
+master.stop()
+EOF
+
+say "scenario 4: worker death mid-sweep (leased ec_encode reassigns)"
+python - <<'EOF'
+import hashlib
+import shutil
+import socket
+import tempfile
+import time
+from pathlib import Path
+
+from seaweedfs_tpu.cluster import operation
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.cluster.wdclient import MasterClient
+from seaweedfs_tpu.pipeline import encode as encode_mod
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.util import retry
+
+
+def port():
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 <= 65535:
+            try:
+                with socket.socket() as s2:
+                    s2.bind(("127.0.0.1", p + 10000))
+                return p
+            except OSError:
+                pass
+
+
+retry.configure(base_delay=0.01, max_delay=0.1)
+work = Path(tempfile.mkdtemp(prefix="seaweed-chaos-s4."))
+master = MasterServer(port=port(), volume_size_limit_mb=64,
+                      pulse_seconds=0.2, seed=42).start()
+for i in range(2):
+    (work / f"v{i}").mkdir(parents=True, exist_ok=True)
+servers = [VolumeServer(Store([work / f"v{i}"], max_volumes=8),
+                        port=port(), master_url=master.url,
+                        data_center="dc1", rack=f"r{i % 2}",
+                        pulse_seconds=0.2,
+                        job_poll_seconds=0.1).start() for i in range(2)]
+deadline = time.time() + 10
+while time.time() < deadline and len(master.topology.nodes) < 2:
+    time.sleep(0.05)
+assert len(master.topology.nodes) == 2, "servers never joined"
+victim, survivor = servers
+
+mc = MasterClient(master.url)
+fids = []
+for i in range(12):
+    a = operation.assign(mc, collection="sweep", replication="010")
+    operation.upload(a.url, a.fid, bytes([40 + i]) * 3000,
+                     jwt=a.auth, collection="sweep")
+    fids.append(a.fid)
+vid = int(fids[0].split(",")[0])
+time.sleep(0.6)
+
+# deterministic choreography: no worker polls until told to
+for vs in servers:
+    vs.job_worker.stop()
+master.jobs.lease_seconds = 1.0
+
+# single-host reference encode of a copy of the survivor's replica
+vol = survivor.store.get_volume(vid, "sweep")
+vol.sync()
+ref_base = work / "refvol"
+for ext in (".dat", ".idx"):
+    shutil.copy2(f"{vol.base}{ext}", f"{ref_base}{ext}")
+encode_mod.encode_volume(ref_base)
+total = encode_mod.DEFAULT_SCHEME.total_shards
+
+
+def hashes(base):
+    return {s: hashlib.sha256(
+        (base.parent / f"{base.name}.ec{s:02d}").read_bytes()).hexdigest()
+        for s in range(total)}
+
+
+ref = hashes(ref_base)
+
+master.jobs.submit("ec_encode", [vid], collection="sweep")
+task = master.jobs.claim(victim.url)
+assert task is not None and task["kind"] == "ec_encode", task
+victim.stop()  # dies mid-sweep, lease never renews
+survivor.job_worker.start()
+
+deadline = time.time() + 30
+while time.time() < deadline:
+    job = master.jobs.to_map()["jobs"][0]
+    if job["state"] in ("done", "failed"):
+        break
+    time.sleep(0.1)
+assert job["state"] == "done", job
+t = job["tasks"][0]
+assert t["worker"] == survivor.url, t
+assert victim.url in t["excluded"], t
+assert t["attempts"] == 2, t
+assert hashes(Path(survivor.store.get_volume(vid, "sweep").base)) == ref
+print(f"lease expired, task reassigned to {survivor.url}, "
+      f"shards byte-identical to single-host encode: OK")
 
 mc.close()
 for vs in servers:

@@ -61,5 +61,17 @@ if [ "$rc" -ne 0 ]; then
     echo "lint_gate: usage_smoke failed (exit $rc) — per-tenant" \
          "accounting or the hot-key sketch regressed; see" \
          "scripts/usage_smoke.sh" >&2
+    exit "$rc"
+fi
+
+# Maintenance-plane smoke (docs/jobs.md): a subprocess cluster runs a
+# distributed ec.encode sweep over leased job tasks and the result is
+# asserted end to end (/cluster/jobs, readbacks, seaweed_jobs_*).
+bash scripts/jobs_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: jobs_smoke failed (exit $rc) — the leased-job" \
+         "orchestration plane regressed; see scripts/jobs_smoke.sh" >&2
 fi
 exit "$rc"

@@ -20,6 +20,7 @@ from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
 from .. import pb
+from ..cache import invalidation as invalidation_mod
 from ..filer import Filer, FilerError
 from ..filer import path_conf as path_conf_mod
 from ..filer.entry import Attr, Entry, FileChunk, normalize_path
@@ -147,6 +148,11 @@ class FilerServer:
                                    component="filer")
             self._usage_pusher = usage_mod.UsagePusher(
                 self.usage, self.master_url, self.url).start()
+            # Job-commit cache invalidation: register this filer's
+            # chunk cache for the master's fan-out (docs/jobs.md).
+            invalidation_mod.start_subscriber(self.master_url,
+                                              self.url,
+                                              self._conf_stop)
         self._load_path_conf()
         t = threading.Thread(target=self._follow_path_conf,
                              daemon=True,
@@ -483,6 +489,17 @@ def _make_http_handler(fs: FilerServer):
             self._upload()
 
         def do_POST(self):
+            if urlparse(self.path).path == "/cache/invalidate":
+                # Maintenance-job fan-out (docs/jobs.md): drop cached
+                # chunks of a volume a job just rewrote.
+                try:
+                    self._send(200, json.dumps(
+                        invalidation_mod.handle_event(
+                            json.loads(self._read_body() or b"{}"))
+                    ).encode())
+                except (ValueError, KeyError) as e:
+                    self._err(400, str(e))
+                return
             self._upload()
 
         def _upload(self):

@@ -39,8 +39,10 @@ from ..util import tls as tls_mod
 from ..util import tracing
 from ..util import varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
+from ..cache import invalidation as invalidation_mod
 from . import ha as ha_mod
 from .ha import NotLeaderError
+from . import jobs as jobs_mod
 from . import usage as usage_mod
 from .sequence import MemorySequencer
 from .telemetry import SloEngine
@@ -123,6 +125,22 @@ class MasterServer:
         #: payload to /cluster/usage. Leader-only for the same reason
         #: as traces/telemetry.
         self.usage = usage_mod.ClusterUsage()
+        #: Maintenance plane (docs/jobs.md): durable per-volume task
+        #: queues pulled by volume servers under leases renewed on the
+        #: heartbeat, plus the policy engine that turns telemetry/usage
+        #: signals into submitted jobs. Leader-only like the other
+        #: /cluster/* planes; the checkpoint keeps sweeps resumable
+        #: across master restarts.
+        self.jobs = jobs_mod.JobManager(
+            topology=self.topology,
+            checkpoint_path=(Path(meta_dir) / "jobs.json")
+            if meta_dir else None,
+            on_commit=self._job_task_committed)
+        self.policy = jobs_mod.PolicyEngine(master=self, jobs=self.jobs)
+        #: Cluster cache-invalidation fan-out: gateways subscribe via
+        #: POST /cluster/cache_subscribe; job commits that mutate a
+        #: volume's bytes publish to subscribers + all volume servers.
+        self.cache_hub = invalidation_mod.ClusterInvalidationHub()
         self._pusher = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
@@ -236,6 +254,13 @@ class MasterServer:
                 glog.warning("master: data node %s missed heartbeats, "
                              "removed from topology", url)
                 self.usage.forget(url)
+                # Reaped workers hand their leased tasks back now
+                # rather than sitting out the rest of the lease.
+                self.jobs.forget_worker(url)
+                self.cache_hub.forget(url)
+            self.jobs.expire()
+            if self.is_leader:
+                self.policy.maybe_tick()
             if self.is_leader and tick % ttl_every == 0 \
                     and (self._ttl_thread is None or
                          not self._ttl_thread.is_alive()):
@@ -257,6 +282,40 @@ class MasterServer:
                     target=self._scan_and_vacuum_safe, daemon=True,
                     name="master-vacuum-scan")
                 self._vacuum_thread.start()
+
+    # ------------- maintenance jobs -------------
+
+    def _job_task_committed(self, task) -> None:
+        """JobManager on_commit hook: a task that changed what a
+        volume's bytes mean (EC seal/rebuild, vacuum, replica drop)
+        fans a cache-invalidation event out to every subscribed
+        gateway plus every other volume server, so remote chunk caches
+        never serve the pre-maintenance bytes."""
+        if task.kind not in jobs_mod.MUTATING_KINDS:
+            return
+        extra = [n.url for n in self.topology.snapshot_nodes()
+                 if n.url != task.worker]
+        self.cache_hub.publish(task.volume_id, reason=task.kind,
+                               origin=task.worker, extra=extra)
+
+    def job_candidate_volumes(self, kind: str,
+                              collection: str = "") -> list[int]:
+        """Enumerate the work-list for a whole-collection submission
+        (``job.submit ec.encode -collection X`` names no volumes):
+        ec_encode targets plain volumes not yet EC'd, ec_rebuild
+        targets EC volumes, the rest every plain volume."""
+        plain: set[int] = set()
+        for node in self.topology.snapshot_nodes():
+            for (col, vid) in node.volumes:
+                if col == collection:
+                    plain.add(vid)
+        if kind == "ec_rebuild":
+            return sorted(
+                vid for vid, col in self.topology.ec_collections.items()
+                if col == collection)
+        if kind == "ec_encode":
+            plain -= set(self.topology.ec_locations)
+        return sorted(plain)
 
     def _reap_ttl_safe(self) -> None:
         try:
@@ -550,6 +609,10 @@ class _MasterServicer:
                                              metrics=ms.metrics)
             if hb.HasField("usage"):
                 ms.usage.ingest_proto(url, hb.usage)
+            if hb.HasField("job_progress"):
+                # The heartbeat IS the lease renewal for every task
+                # the worker still reports in flight.
+                ms.jobs.renew(url, hb.job_progress)
             if hb.max_file_key:
                 ms.sequencer.set_max(hb.max_file_key)
             yield master_pb2.HeartbeatResponse(
@@ -757,6 +820,7 @@ def _make_http_handler(ms: MasterServer):
                     body = (ms.metrics.render()
                             + ms.slo.metrics.render()
                             + ms.usage.metrics.render()
+                            + ms.jobs.metrics.render()
                             + tracing.METRICS.render()
                             + retry.METRICS.render()).encode()
                     self.send_response(200)
@@ -794,6 +858,15 @@ def _make_http_handler(ms: MasterServer):
                         return
                     self._json(ms.usage.topk_map(
                         int(q.get("n", 32))))
+                elif u.path == "/cluster/jobs":
+                    # Jobs live on the leader (claims/completions and
+                    # heartbeat renewals land there), so read there.
+                    if self._proxy_to_leader():
+                        return
+                    doc = ms.jobs.to_map(
+                        with_tasks=q.get("tasks", "1") != "0")
+                    doc["policy"] = ms.policy.payload()
+                    self._json(doc)
                 elif u.path == "/cluster/slo":
                     if self._proxy_to_leader():
                         return
@@ -841,6 +914,8 @@ def _make_http_handler(ms: MasterServer):
                                "nodes": len(ms.topology.nodes),
                                "slo_state": ms.slo.worst_state(),
                                "slo_alerts": list(ms.slo.alerts),
+                               "jobs": ms.jobs.summary(),
+                               "cache_hub": ms.cache_hub.to_map(),
                                "trace_collector":
                                    ms.trace_collector.payload(0)}))
                 else:
@@ -903,6 +978,64 @@ def _make_http_handler(ms: MasterServer):
                     self._json({"ok": True})
                 except (ValueError, OSError) as e:
                     self._json({"error": str(e)}, 400)
+            elif u.path.startswith("/cluster/jobs/"):
+                # Maintenance-job control plane: all writes go to the
+                # leader (whose JobManager owns the work-lists).
+                if self._proxy_to_leader():
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    action = u.path[len("/cluster/jobs/"):]
+                    if action == "submit":
+                        kind = str(body.get("kind", ""))
+                        vids = body.get("volumes") or []
+                        if not vids:
+                            vids = ms.job_candidate_volumes(
+                                kind, str(body.get("collection", "")))
+                        self._json({"job": ms.jobs.submit(
+                            kind, vids,
+                            collection=str(body.get("collection", "")),
+                            params=body.get("params") or {},
+                            parallel=int(body.get("parallel", 0)),
+                            submitted_by=str(
+                                body.get("submittedBy", "http")))})
+                    elif action == "claim":
+                        self._json({"task": ms.jobs.claim(
+                            q.get("worker", ""))})
+                    elif action == "complete":
+                        self._json(ms.jobs.complete(
+                            str(body.get("worker", "")),
+                            str(body.get("taskId", "")),
+                            bool(body.get("ok")),
+                            str(body.get("error", ""))))
+                    elif action in ("pause", "resume", "cancel"):
+                        job_id = q.get("job", "") or str(
+                            body.get("jobId", ""))
+                        self._json({"job": getattr(ms.jobs, action)(
+                            job_id)})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except KeyError as e:
+                    self._json({"error": str(e.args[0])}, 404)
+                except (ValueError, OSError) as e:
+                    self._json({"error": str(e)}, 400)
+            elif u.path == "/cluster/cache_subscribe":
+                # Gateways (filer/S3/WebDAV chunk caches) register here
+                # for job-commit invalidation fan-out; re-subscribing
+                # refreshes the entry, so a periodic loop survives
+                # leader changes.
+                if self._proxy_to_leader():
+                    return
+                url = q.get("url", "")
+                if not url:
+                    self._json({"error": "url query parameter "
+                                "required"}, 400)
+                else:
+                    ms.cache_hub.subscribe(url)
+                    self._json({"ok": True,
+                                "subscribers":
+                                    len(ms.cache_hub.to_map())})
             elif u.path == "/vol/grow":
                 if self._proxy_to_leader():
                     return
@@ -963,6 +1096,14 @@ def main(argv: Optional[list[str]] = None) -> int:
                           conf, "tracing.collector_ring_size", 256)))
     if config_mod.lookup(conf, "slo") is not None:
         ms.slo.configure(conf)
+    jobs_mod.configure_from(conf)
+    jsec = config_mod.lookup(conf, "jobs")
+    if jsec is not None:
+        ms.jobs.lease_seconds = float(
+            jsec.get("lease_seconds", ms.jobs.lease_seconds))
+        ms.jobs.max_attempts = int(
+            jsec.get("max_attempts", ms.jobs.max_attempts))
+        ms.policy.configure(jsec)
     ms.start()
     try:
         while True:

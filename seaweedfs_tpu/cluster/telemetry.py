@@ -411,6 +411,23 @@ class ClusterTelemetry:
                 merged.merge(d)
         return merged
 
+    def volume_read_rates(self) -> dict[int, float]:
+        """Cluster-wide per-volume read-op EWMA, summed across every
+        node serving the volume (replicas and EC shards alike). This
+        is the signal the jobs policy engine thresholds against for
+        cold-EC / hot-replicate / cool-shrink decisions, so the sum
+        must see total demand on the volume, not one replica's share
+        of it."""
+        now = self.clock()
+        with self._lock:
+            out: dict[int, float] = {}
+            for node in self._nodes.values():
+                decay = self._decay_factor(node, now)
+                for vid, agg in node.volumes.items():
+                    out[vid] = out.get(vid, 0.0) \
+                        + agg.rates["read_ops"] * decay
+            return out
+
     def node_quantile(self, node_url: str, q: float,
                       read: bool = True) -> Optional[float]:
         """Merged latency quantile across a node's recent windows."""

@@ -40,6 +40,8 @@ from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
 from ..util import faults, glog, profiler, retry, security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
+from ..cache import invalidation as invalidation_mod
+from . import jobs as jobs_mod
 from . import telemetry as telemetry_mod
 from . import usage as usage_mod
 from .master import _grpc_port
@@ -92,7 +94,8 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "",
                  rack: str = "", pulse_seconds: float = 5.0,
                  secret: str = "", read_mode: str = "proxy",
-                 ec_cache_bytes: int = 64 * 1024 * 1024):
+                 ec_cache_bytes: int = 64 * 1024 * 1024,
+                 job_poll_seconds: Optional[float] = None):
         self.store = store
         self.ip = ip
         self.port = port
@@ -122,6 +125,12 @@ class VolumeServer:
         #: master's /cluster/topk can name hot objects per volume.
         self.usage = usage_mod.UsageCollector("volume")
         self.volume_size_limit = 30 * 1024 ** 3
+        #: Maintenance-plane worker: pulls leased tasks from the master
+        #: (docs/jobs.md) and executes them through the same servicer
+        #: the shell's gRPC choreography uses.
+        self.job_poll_seconds = job_poll_seconds
+        self.job_worker: Optional[jobs_mod.JobWorker] = None
+        self.servicer: Optional["_VolumeServicer"] = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
         self._http_server: Optional[ThreadingHTTPServer] = None
@@ -143,8 +152,9 @@ class VolumeServer:
         self._grpc_server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             interceptors=(auth,) if auth else ())
+        self.servicer = _VolumeServicer(self)
         self._grpc_server.add_generic_rpc_handlers((pb.generic_handler(
-            pb.VOLUME_SERVICE, pb.VOLUME_METHODS, _VolumeServicer(self)),))
+            pb.VOLUME_SERVICE, pb.VOLUME_METHODS, self.servicer),))
         bound = tls_mod.serve_port(
             self._grpc_server, f"{self.ip}:{_grpc_port(self.port)}")
         if bound == 0:
@@ -168,12 +178,16 @@ class VolumeServer:
             # collector; followers proxy the POST to the leader.
             tracing.configure_push(self.master_url, node=self.url,
                                    component="volume")
+            self.job_worker = jobs_mod.JobWorker(
+                self, poll_seconds=self.job_poll_seconds).start()
         glog.info("volume server started at %s (grpc %d)", self.url,
                   _grpc_port(self.port))
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.job_worker is not None:
+            self.job_worker.stop()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         if self._http_server:
@@ -290,6 +304,9 @@ class VolumeServer:
                 collections=collections))
         if usage_mod.enabled():
             hb.usage.CopyFrom(self.usage.snapshot())
+        if jobs_mod.enabled() and self.job_worker is not None:
+            # Naming an in-flight task here renews its lease.
+            hb.job_progress.CopyFrom(self.job_worker.progress_proto())
         return hb
 
     def _heartbeat_loop(self) -> None:
@@ -996,7 +1013,9 @@ def _make_http_handler(vs: VolumeServer):
                     "volume", vs.metrics,
                     extra={"telemetry": vs.telemetry.to_map(),
                            "cache": vs.chunk_cache.stats(),
-                           "usage": vs.usage.to_payload()}))
+                           "usage": vs.usage.to_payload(),
+                           "jobs": (vs.job_worker.summary()
+                                    if vs.job_worker else None)}))
                 return
             t0 = time.perf_counter()
             vid = None
@@ -1054,6 +1073,17 @@ def _make_http_handler(vs: VolumeServer):
                 self.end_headers()
 
         def do_POST(self):
+            if urlparse(self.path).path == "/cache/invalidate":
+                # Cluster invalidation fan-out (job commits on other
+                # nodes): funnel into the local registry before the
+                # fid parser rejects the path.
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    self._json(invalidation_mod.handle_event(payload))
+                except (ValueError, OSError) as e:
+                    self._json({"error": str(e)}, 400)
+                return
             t0 = time.perf_counter()
             vid = None
             n_written = 0
@@ -1173,13 +1203,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     profiler.ensure_started()
     from ..pipeline import pipe as pipe_mod
     pipe_mod.configure_from(conf)
+    jobs_mod.configure_from(conf)
+    job_poll = config_mod.lookup(conf, "jobs.poll_seconds")
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,
                   needle_map=args.index)
     store.load_existing()
     vs = VolumeServer(store, ip=args.ip, port=args.port,
                       master_url=args.mserver, public_url=args.publicUrl,
                       data_center=args.dataCenter, rack=args.rack,
-                      pulse_seconds=args.pulseSeconds, secret=secret)
+                      pulse_seconds=args.pulseSeconds, secret=secret,
+                      job_poll_seconds=(float(job_poll)
+                                        if job_poll is not None else None))
     vs.start()
     try:
         while True:

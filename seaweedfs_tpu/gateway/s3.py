@@ -17,6 +17,7 @@ default).
 from __future__ import annotations
 
 import hashlib
+import json
 import threading
 import time
 import urllib.parse
@@ -25,6 +26,7 @@ import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..cache import invalidation as invalidation_mod
 from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
@@ -192,6 +194,11 @@ class S3Gateway:
         if self.master_url:
             self._usage_pusher = usage_mod.UsagePusher(
                 self.usage, self.master_url, f"s3@{self.url}").start()
+            # Job-commit cache invalidation: register this gateway's
+            # chunk cache for the master's fan-out (docs/jobs.md).
+            invalidation_mod.start_subscriber(self.master_url,
+                                              self.url,
+                                              self._conf_stop)
         glog.info("s3 gateway at %s -> filer %s", self.url,
                   self.filer.filer_url)
         return self
@@ -731,6 +738,20 @@ def _make_handler(gw: S3Gateway):
                            seconds=time.perf_counter() - t0, error=err)
 
         def do_POST(self):
+            if urllib.parse.urlsplit(self.path).path == \
+                    "/cache/invalidate":
+                # Maintenance-job fan-out (docs/jobs.md): drop cached
+                # chunks of a volume a job just rewrote.
+                try:
+                    self._send(200, json.dumps(
+                        invalidation_mod.handle_event(
+                            json.loads(self._body() or b"{}"))
+                    ).encode(), ctype="application/json")
+                except (ValueError, KeyError) as e:
+                    self._send(400, json.dumps(
+                        {"error": str(e)}).encode(),
+                        ctype="application/json")
+                return
             bucket, key, q, _ = self._split()
             body = self._body()
             ident = None

@@ -16,6 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..cache import global_chunk_cache
+from ..cache import invalidation as invalidation_mod
 from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..util import glog
@@ -57,6 +58,7 @@ class WebDavServer:
         self._usage_pusher: Optional[usage_mod.UsagePusher] = None
         self._http_server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     def start(self) -> "WebDavServer":
         self._http_server = ThreadingHTTPServer(
@@ -69,11 +71,16 @@ class WebDavServer:
             self._usage_pusher = usage_mod.UsagePusher(
                 self.usage, self.master_url,
                 f"webdav@{self.url}").start()
+            # Job-commit cache invalidation: register this gateway's
+            # chunk cache for the master's fan-out (docs/jobs.md).
+            invalidation_mod.start_subscriber(self.master_url,
+                                              self.url, self._stop)
         glog.info("webdav at %s -> filer %s", self.url,
                   self.filer.filer_url)
         return self
 
     def stop(self) -> None:
+        self._stop.set()
         if self._usage_pusher:
             self._usage_pusher.stop()
         if self._http_server:
@@ -163,6 +170,26 @@ def _make_handler(dav: WebDavServer):
                 "DAV": "1",
                 "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, "
                          "MKCOL, MOVE, COPY"})
+
+        def do_POST(self):
+            # DAV itself has no POST; the one accepted path is the
+            # maintenance-job cache-invalidation fan-out (docs/jobs.md).
+            import json
+
+            if urllib.parse.urlsplit(self.path).path != \
+                    "/cache/invalidate":
+                self._send(405)
+                return
+            n = int(self.headers.get("Content-Length", "0"))
+            try:
+                self._send(200, json.dumps(
+                    invalidation_mod.handle_event(json.loads(
+                        self.rfile.read(n) if n else b"{}"))
+                ).encode(), ctype="application/json")
+            except (ValueError, KeyError) as e:
+                self._send(400, json.dumps(
+                    {"error": str(e)}).encode(),
+                    ctype="application/json")
 
         def do_PROPFIND(self):
             n = int(self.headers.get("Content-Length", "0"))

@@ -143,7 +143,7 @@ class ClusterEnv:
     # -- master HTTP plumbing --
 
     def _master_http(self, path_q: str, method: str = "GET",
-                     host: str = "") -> dict:
+                     host: str = "", body: Optional[dict] = None) -> dict:
         """One JSON request against a master's HTTP plane with the
         error mapping every caller needs (HTTPError body -> message,
         connection failure -> ShellError naming the master)."""
@@ -154,9 +154,11 @@ class ClusterEnv:
 
         host = host or self.master_url
         try:
-            resp = retry.http_request(f"http://{host}{path_q}",
-                                      method=method,
-                                      point="master.rpc", timeout=30)
+            resp = retry.http_request(
+                f"http://{host}{path_q}", method=method,
+                data=(None if body is None
+                      else json_mod.dumps(body).encode()),
+                point="master.rpc", timeout=30)
             return json_mod.loads(resp.data or b"{}")
         except urllib.error.HTTPError as e:
             try:
@@ -331,6 +333,7 @@ DESTRUCTIVE_COMMANDS = {
     "s3.configure", "fs.configure", "s3.clean.uploads", "volume.fsck",
     "volume.mount", "volume.unmount",
     "volume.configure.replication",
+    "job.submit", "job.cancel",
 }
 
 
@@ -364,14 +367,41 @@ def _spread_targets(nodes: list[EcNode], total: int) -> list[EcNode]:
 def cmd_ec_encode(env: ClusterEnv, argv: list[str]) -> None:
     """Full §3.1 choreography: mark readonly -> generate on the owning
     server -> spread shards rack-aware (copy+mount, delete moved) ->
-    delete the source volume."""
+    delete the source volume. With ``-distributed`` the shell only
+    submits a JobManager sweep — every volume server encodes its own
+    volumes in parallel under leases (docs/jobs.md) — and waits."""
     p = _parser("ec.encode")
-    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-volumeId", type=int, default=0)
     p.add_argument("-collection", default="")
     p.add_argument("-dataShards", type=int, default=0)
     p.add_argument("-parityShards", type=int, default=0)
+    p.add_argument("-distributed", action="store_true",
+                   help="run as a leased job sweep on the workers")
+    p.add_argument("-parallel", type=int, default=0,
+                   help="with -distributed: max concurrent tasks")
     args = p.parse_args(argv)
     vid, col = args.volumeId, args.collection
+    if args.distributed:
+        params = {}
+        if args.dataShards and args.parityShards:
+            params = {"data_shards": args.dataShards,
+                      "parity_shards": args.parityShards}
+        doc = env._master_http(
+            "/cluster/jobs/submit", method="POST",
+            body={"kind": "ec_encode", "collection": col,
+                  "volumes": [vid] if vid else [],
+                  "params": params, "parallel": args.parallel,
+                  "submittedBy": "shell"})
+        job = doc["job"]
+        env.println(f"job {job['jobId']}: distributed ec.encode over "
+                    f"{job['total']} volume(s)")
+        job = _wait_for_job(env, job["jobId"])
+        if job["state"] != "done":
+            raise ShellError(f"job {job['jobId']} {job['state']}")
+        return
+    if not vid:
+        raise ShellError("ec.encode: -volumeId required "
+                         "(or use -distributed)")
 
     locs = env.volume_locations(vid)
     if not locs:
@@ -2043,6 +2073,143 @@ def cmd_tenant_usage(env: ClusterEnv, argv: list[str]) -> None:
         f"out={_fmt_bytes(totals.get('bytes_out', 0))} "
         f"errors={totals.get('errors', 0)} "
         f"(sources: {', '.join(sorted(doc.get('sources', {})))})")
+
+
+def _job_kind(name: str) -> str:
+    """Shell spelling (``ec.encode``) -> manager kind (``ec_encode``)."""
+    return name.replace(".", "_")
+
+
+def _wait_for_job(env: ClusterEnv, job_id: str,
+                  timeout: float = 600.0,
+                  poll_seconds: float = 0.5) -> dict:
+    """Poll /cluster/jobs until ``job_id`` reaches a terminal state,
+    printing progress transitions as they happen."""
+    import time as time_mod
+
+    deadline = time_mod.monotonic() + timeout
+    last = ""
+    while True:
+        doc = env._master_http("/cluster/jobs?tasks=0")
+        jobs = {j["jobId"]: j for j in doc.get("jobs", ())}
+        job = jobs.get(job_id)
+        if job is None:
+            raise ShellError(f"job {job_id} vanished from the master")
+        counts = job.get("taskCounts", {})
+        line = (f"{job['state']}: " + ", ".join(
+            f"{n} {s}" for s, n in sorted(counts.items())))
+        if line != last:
+            env.println(f"job {job_id} {line}")
+            last = line
+        if job["state"] in ("done", "failed", "cancelled"):
+            return job
+        if time_mod.monotonic() > deadline:
+            raise ShellError(f"job {job_id} still {job['state']} after "
+                             f"{timeout:.0f}s")
+        time_mod.sleep(poll_seconds)
+
+
+@cluster_command("job.submit")
+def cmd_job_submit(env: ClusterEnv, argv: list[str]) -> None:
+    """Queue a maintenance sweep on the master's JobManager — volume
+    servers pull the per-volume tasks under leases (docs/jobs.md).
+    ``job.submit ec.encode -collection X -parallel N`` sweeps the
+    whole collection; ``-volumeId 3,7`` names volumes explicitly."""
+    p = _parser("job.submit")
+    p.add_argument("kind",
+                   help="ec.encode | ec.rebuild | vacuum | replicate "
+                        "| replica.drop")
+    p.add_argument("-collection", default="")
+    p.add_argument("-volumeId", default="",
+                   help="comma-separated ids; default: every candidate "
+                        "volume of the collection")
+    p.add_argument("-parallel", type=int, default=0,
+                   help="max concurrently leased tasks (0 = unlimited)")
+    p.add_argument("-wait", action="store_true",
+                   help="block until the job reaches a terminal state")
+    args = p.parse_args(argv)
+    vols = [int(x) for x in args.volumeId.split(",") if x]
+    doc = env._master_http(
+        "/cluster/jobs/submit", method="POST",
+        body={"kind": _job_kind(args.kind), "collection": args.collection,
+              "volumes": vols, "parallel": args.parallel,
+              "submittedBy": "shell"})
+    job = doc["job"]
+    env.println(f"job {job['jobId']}: {job['total']} "
+                f"{job['kind']} task(s) queued")
+    if args.wait:
+        job = _wait_for_job(env, job["jobId"])
+        if job["state"] != "done":
+            raise ShellError(f"job {job['jobId']} {job['state']}")
+
+
+@cluster_command("job.status")
+def cmd_job_status(env: ClusterEnv, argv: list[str]) -> None:
+    """Show the maintenance plane: every job's task counts, plus the
+    policy engine's thresholds and recent autonomous actions."""
+    p = _parser("job.status")
+    p.add_argument("-job", default="", help="show one job's tasks")
+    args = p.parse_args(argv)
+    doc = env._master_http("/cluster/jobs")
+    if args.job:
+        jobs = [j for j in doc.get("jobs", ())
+                if j["jobId"] == args.job]
+        if not jobs:
+            raise ShellError(f"unknown job {args.job}")
+        for t in jobs[0].get("tasks", ()):
+            err = f"  {t['error']}" if t["error"] else ""
+            env.println(
+                f"{t['taskId']}: {t['kind']} volume {t['volumeId']} "
+                f"{t['state']} ({t['fraction']:.0%} on "
+                f"{t['worker'] or '-'}, attempt {t['attempts']}){err}")
+        return
+    jobs = doc.get("jobs", ())
+    if not jobs:
+        env.println("no jobs")
+    for j in jobs:
+        counts = ", ".join(f"{n} {s}" for s, n in
+                           sorted(j.get("taskCounts", {}).items()))
+        env.println(f"{j['jobId']}: {j['kind']} "
+                    f"[{j['collection'] or 'default'}] {j['state']} "
+                    f"({counts or 'empty'})")
+    pol = doc.get("policy", {})
+    env.println(f"policy: {'on' if pol.get('enabled') else 'off'}, "
+                f"{pol.get('ticks', 0)} tick(s), "
+                f"{len(pol.get('actions', ()))} recent action(s)")
+
+
+@cluster_command("job.pause")
+def cmd_job_pause(env: ClusterEnv, argv: list[str]) -> None:
+    """Stop handing out a job's pending tasks (in-flight leases
+    finish); job.resume continues it."""
+    p = _parser("job.pause")
+    p.add_argument("-job", required=True)
+    args = p.parse_args(argv)
+    job = env._master_http(f"/cluster/jobs/pause?job={args.job}",
+                           method="POST")["job"]
+    env.println(f"job {job['jobId']} {job['state']}")
+
+
+@cluster_command("job.resume")
+def cmd_job_resume(env: ClusterEnv, argv: list[str]) -> None:
+    p = _parser("job.resume")
+    p.add_argument("-job", required=True)
+    args = p.parse_args(argv)
+    job = env._master_http(f"/cluster/jobs/resume?job={args.job}",
+                           method="POST")["job"]
+    env.println(f"job {job['jobId']} {job['state']}")
+
+
+@cluster_command("job.cancel")
+def cmd_job_cancel(env: ClusterEnv, argv: list[str]) -> None:
+    """Terminally stop a job: pending tasks are never handed out
+    again; a task already leased still reports its completion."""
+    p = _parser("job.cancel")
+    p.add_argument("-job", required=True)
+    args = p.parse_args(argv)
+    job = env._master_http(f"/cluster/jobs/cancel?job={args.job}",
+                           method="POST")["job"]
+    env.println(f"job {job['jobId']} {job['state']}")
 
 
 def run_cluster_command(env: ClusterEnv, line: str) -> None:

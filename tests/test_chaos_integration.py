@@ -158,6 +158,97 @@ def test_error_and_latency_storm_absorbed_by_retries(tmp_path_factory):
         _teardown(master, servers)
 
 
+def test_worker_death_mid_sweep_reassigns_and_matches_reference(
+        tmp_path_factory, tmp_path):
+    """Maintenance-plane chaos (docs/jobs.md): a volume server dies
+    holding a leased ec_encode task. The lease must expire, the task
+    re-queue with the dead worker excluded, the surviving replica
+    holder finish the sweep — and its shard files must be sha256-
+    identical to a synchronous single-host encode of the same replica
+    (zero duplicate/missing shards)."""
+    import hashlib
+    import shutil
+
+    from seaweedfs_tpu.pipeline import encode as encode_mod
+
+    master, servers = _mini_cluster(tmp_path_factory, 2)
+    victim, survivor = servers
+    mc = MasterClient(master.url)
+    try:
+        # replicated volume: both servers hold identical .dat bytes
+        fids = []
+        for i in range(12):
+            a = operation.assign(mc, collection="sweep",
+                                 replication="010")
+            operation.upload(a.url, a.fid, bytes([40 + i]) * 3000,
+                             jwt=a.auth, collection="sweep")
+            fids.append(a.fid)
+        vid = int(fids[0].split(",")[0])
+        time.sleep(2.5 * PULSE)
+
+        # deterministic choreography: no worker polls until told to
+        for vs in servers:
+            vs.job_worker.stop()
+        master.jobs.lease_seconds = 1.0
+
+        # single-host reference: encode a copy of the survivor's
+        # replica out-of-band; shard bytes depend only on .dat content
+        vol = survivor.store.get_volume(vid, "sweep")
+        vol.sync()
+        ref_base = tmp_path / "refvol"
+        for ext in (".dat", ".idx"):
+            shutil.copy2(f"{vol.base}{ext}", f"{ref_base}{ext}")
+        encode_mod.encode_volume(ref_base)
+        total_shards = encode_mod.DEFAULT_SCHEME.total_shards
+
+        def _hashes(base):
+            return {s: hashlib.sha256(
+                (base.parent / f"{base.name}.ec{s:02d}")
+                .read_bytes()).hexdigest()
+                for s in range(total_shards)}
+
+        ref = _hashes(ref_base)
+
+        master.jobs.submit("ec_encode", [vid], collection="sweep")
+        task = master.jobs.claim(victim.url)
+        assert task is not None and task["kind"] == "ec_encode"
+        victim.stop()              # dies mid-sweep, lease never renews
+
+        # reap loop expires the lease and re-queues with the dead
+        # worker excluded; the survivor's worker then picks it up
+        survivor.job_worker.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            job = master.jobs.to_map()["jobs"][0]
+            if job["state"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert job["state"] == "done", job
+        t = job["tasks"][0]
+        assert t["worker"] == survivor.url
+        assert victim.url in t["excluded"]
+        assert t["attempts"] == 2
+        assert master.jobs.expired_total >= 1
+
+        # all shards present in the topology, none duplicated
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            shards = master.topology.ec_locations.get(vid, {})
+            if len(shards) == total_shards:
+                break
+            time.sleep(0.1)
+        assert set(shards) == set(range(total_shards))
+        assert all(urls == {survivor.url}
+                   for urls in shards.values()), shards
+
+        # byte-identical to the single-host reference encode
+        out = _hashes(survivor.store.get_volume(vid, "sweep").base)
+        assert out == ref
+    finally:
+        mc.close()
+        _teardown(master, servers)
+
+
 def test_truncated_ec_shard_reads_reconstruct(tmp_path_factory):
     import grpc
 

@@ -1,0 +1,458 @@
+"""Maintenance plane: JobManager leases, policy hysteresis, e2e loops.
+
+Three layers, mirroring docs/jobs.md:
+
+1. JobManager unit tests against a fake clock — claim/renew/expiry,
+   excluded-worker re-queue, stale completions, terminal failure after
+   max_attempts, pause/cancel, checkpoint/resume across a simulated
+   master restart.
+2. PolicyEngine.evaluate over synthesized rows — the grow/shrink
+   hysteresis band and per-volume cooldown must keep a volume
+   oscillating around the hot threshold from flapping.
+3. In-process mini-cluster e2e — a distributed ec_encode sweep over 4
+   volumes with 2 workers (with the job-commit cache-invalidation
+   fan-out observed), and the closed policy loop: hot reads grow a
+   replica that /dir/lookup then serves, load stops, the replica is
+   shrunk back (ISSUE 9 acceptance).
+"""
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cache import invalidation
+from seaweedfs_tpu.cluster import jobs as jobs_mod
+from seaweedfs_tpu.cluster import operation
+from seaweedfs_tpu.cluster.jobs import JobManager, PolicyEngine
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.cluster.wdclient import MasterClient
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.util import retry
+
+PULSE = 0.2
+W1, W2 = "10.0.0.1:8080", "10.0.0.2:8080"
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _progress(task_id, job_id="j1", fraction=0.5):
+    jp = master_pb2.JobProgress()
+    jp.tasks.add(task_id=task_id, job_id=job_id, kind="ec_encode",
+                 volume_id=1, state="running", fraction=fraction)
+    return jp
+
+
+# ---------------------------------------------------------------------------
+# JobManager units (no topology: eligibility is exclusion-list only)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_renewal_extends_expiry():
+    clock = FakeClock()
+    jm = JobManager(lease_seconds=10.0, clock=clock)
+    jm.submit("ec_encode", [1])
+    t = jm.claim(W1)
+    assert t["taskId"] == "j1.t1"
+    # without renewal the lease would die at t+10; three heartbeats
+    # later it must still be live well past that
+    for dt in (8.0, 16.0, 24.0):
+        clock.t = 1000.0 + dt
+        assert jm.renew(W1, _progress("j1.t1")) == 1
+        assert jm.expire() == []
+    # fraction from the heartbeat is folded in
+    assert jm.to_map()["jobs"][0]["tasks"][0]["fraction"] == 0.5
+    # silence for a full lease kills it
+    clock.t = 1000.0 + 24.0 + 10.1
+    assert jm.expire() == ["j1.t1"]
+
+
+def test_expired_lease_requeues_with_worker_excluded():
+    clock = FakeClock()
+    jm = JobManager(lease_seconds=5.0, clock=clock)
+    jm.submit("vacuum", [7])
+    assert jm.claim(W1)["taskId"] == "j1.t1"
+    clock.t += 5.1
+    assert jm.expire() == ["j1.t1"]
+    # the dead worker is excluded; a fresh worker gets the re-queue
+    assert jm.claim(W1) is None
+    t = jm.claim(W2)
+    assert t is not None and t["taskId"] == "j1.t1"
+    assert jm.expired_total == 1
+
+
+def test_stale_completion_is_ignored():
+    clock = FakeClock()
+    jm = JobManager(lease_seconds=5.0, clock=clock)
+    jm.submit("ec_encode", [1])
+    jm.claim(W1)
+    clock.t += 5.1
+    jm.expire()
+    t = jm.claim(W2)
+    # W1's late completion (its lease already expired) must not commit
+    assert jm.complete(W1, t["taskId"], True).get("stale") is True
+    assert jm.stale_completions == 1
+    # the live holder's completion does
+    assert jm.complete(W2, t["taskId"], True)["state"] == "done"
+    assert jm.to_map()["jobs"][0]["state"] == "done"
+
+
+def test_failure_requeues_then_fails_terminally():
+    clock = FakeClock()
+    jm = JobManager(lease_seconds=5.0, max_attempts=2, clock=clock)
+    jm.submit("ec_encode", [1])
+    jm.claim(W1)
+    assert jm.complete(W1, "j1.t1", False,
+                       "boom")["state"] == "pending"
+    # W1 is excluded after its failure; W2 takes attempt 2 of 2 and
+    # its failure is terminal for the task AND the job
+    assert jm.claim(W1) is None
+    jm.claim(W2)
+    assert jm.complete(W2, "j1.t1", False, "boom")["state"] == "failed"
+    job = jm.to_map()["jobs"][0]
+    assert job["state"] == "failed"
+    assert job["tasks"][0]["error"] == "boom"
+
+
+def test_parallel_cap_limits_concurrent_leases():
+    jm = JobManager(lease_seconds=30.0, clock=FakeClock())
+    jm.submit("ec_encode", [1, 2, 3], parallel=1)
+    assert jm.claim(W1) is not None
+    assert jm.claim(W2) is None          # cap reached
+    jm.complete(W1, "j1.t1", True)
+    assert jm.claim(W2) is not None      # freed slot
+
+
+def test_pause_and_cancel_stop_handout():
+    jm = JobManager(clock=FakeClock())
+    jm.submit("ec_encode", [1, 2])
+    jm.pause("j1")
+    assert jm.claim(W1) is None
+    jm.resume("j1")
+    t = jm.claim(W1)
+    assert t is not None
+    jm.cancel("j1")
+    assert jm.claim(W2) is None
+    # in-flight lease still lands its completion after cancel
+    assert jm.complete(W1, t["taskId"], True)["state"] == "done"
+
+
+def test_checkpoint_resume_across_master_restart(tmp_path):
+    path = tmp_path / "jobs.json"
+    clock = FakeClock()
+    jm = JobManager(checkpoint_path=path, lease_seconds=5.0, clock=clock)
+    jm.submit("ec_encode", [1, 2, 3], collection="c", parallel=2)
+    t = jm.claim(W1)
+    jm.complete(W1, t["taskId"], True)
+    jm.claim(W2)                         # leased at "crash" time
+    # simulated restart: a fresh manager loads the same checkpoint
+    jm2 = JobManager(checkpoint_path=path, lease_seconds=5.0,
+                     clock=clock)
+    states = {t["taskId"]: t["state"]
+              for t in jm2.to_map()["jobs"][0]["tasks"]}
+    assert states[t["taskId"]] == "done"         # done is durable
+    assert "leased" not in states.values()       # leases are not
+    assert sorted(states.values()) == ["done", "pending", "pending"]
+    # job ids keep counting from where the dead master stopped
+    assert jm2.submit("vacuum", [9])["jobId"] == "j2"
+    # and the resumed sweep finishes without re-running the done task
+    seen = set()
+    while True:
+        nt = jm2.claim(W1)
+        if nt is None:
+            break
+        seen.add(nt["volumeId"])
+        jm2.complete(W1, nt["taskId"], True)
+    assert jm2.to_map()["jobs"][0]["state"] == "done"
+    assert t["volumeId"] not in seen
+
+
+def test_corrupt_checkpoint_starts_empty(tmp_path):
+    path = tmp_path / "jobs.json"
+    path.write_text("{not json", encoding="utf-8")
+    jm = JobManager(checkpoint_path=path)
+    assert jm.to_map()["jobs"] == []
+    jm.submit("ec_encode", [1])          # and checkpointing works again
+    assert json.loads(path.read_text())["jobs"][0]["jobId"] == "j1"
+
+
+def test_submit_rejects_unknown_kind_and_empty_volumes():
+    jm = JobManager()
+    with pytest.raises(ValueError):
+        jm.submit("defrag", [1])
+    with pytest.raises(ValueError):
+        jm.submit("ec_encode", [])
+
+
+# ---------------------------------------------------------------------------
+# policy hysteresis
+# ---------------------------------------------------------------------------
+
+
+def _policy(clock, jobs=None):
+    pe = PolicyEngine(jobs=jobs, clock=clock)
+    pe.configure({"policy": True, "hot_read_ops_per_second": 10.0,
+                  "cool_read_ops_per_second": 1.0,
+                  "cooldown_seconds": 60.0, "max_replicas": 3})
+    return pe
+
+
+def _row(rate, replicas=1, **kw):
+    row = {"volume_id": 5, "collection": "c", "size": 100,
+           "read_only": False, "replicas": replicas, "placement": "000",
+           "read_rate": rate, "is_ec": False, "limit": 10_000}
+    row.update(kw)
+    return row
+
+
+def test_policy_no_flapping_inside_hysteresis_band():
+    clock = FakeClock()
+    pe = _policy(clock)
+    # oscillating BETWEEN cool (1.0) and hot (10.0): never an action,
+    # regardless of replica count — this is the anti-flap guarantee
+    for i in range(20):
+        clock.t += 120.0
+        rate = 9.5 if i % 2 else 1.5
+        assert pe.evaluate([_row(rate, replicas=1 + i % 2)]) == []
+
+
+def test_policy_grow_then_shrink_with_cooldown():
+    clock = FakeClock()
+    pe = _policy(clock)
+    # hot -> grow one replica
+    acts = pe.evaluate([_row(50.0, replicas=1)])
+    assert [a["action"] for a in acts] == ["replicate"]
+    # still hot immediately after: cooldown suppresses a second grow
+    assert pe.evaluate([_row(50.0, replicas=1)]) == []
+    # past cooldown, at max_replicas: no further grow
+    clock.t += 61.0
+    assert pe.evaluate([_row(50.0, replicas=3)]) == []
+    # mid-band cooling: NOT below cool yet, so no shrink
+    clock.t += 61.0
+    assert pe.evaluate([_row(5.0, replicas=2)]) == []
+    # truly cold and above base placement count: shrink
+    acts = pe.evaluate([_row(0.2, replicas=2)])
+    assert [a["action"] for a in acts] == ["replica_drop"]
+    # never below the placement's own copy count
+    clock.t += 61.0
+    assert pe.evaluate([_row(0.2, replicas=1)]) == []
+
+
+def test_policy_cold_full_volume_goes_to_ec():
+    clock = FakeClock()
+    pe = _policy(clock)
+    acts = pe.evaluate([_row(0.0, read_only=True)])
+    assert [a["action"] for a in acts] == ["ec_encode"]
+    # an already-EC volume is never re-encoded
+    clock.t += 61.0
+    assert pe.evaluate([_row(0.0, read_only=True, is_ec=True)]) == []
+    # a full-but-hot volume is NOT sealed away from its readers
+    clock.t += 61.0
+    assert pe.evaluate([_row(50.0, read_only=True, replicas=3)]) == []
+
+
+def test_policy_skips_volumes_with_active_jobs():
+    clock = FakeClock()
+    jm = JobManager(clock=clock)
+    jm.submit("replicate", [5])
+    pe = _policy(clock, jobs=jm)
+    assert pe.evaluate([_row(50.0, replicas=1)]) == []
+
+
+def test_policy_rejects_inverted_hysteresis_band():
+    with pytest.raises(ValueError):
+        PolicyEngine().configure({"hot_read_ops_per_second": 1.0,
+                                  "cool_read_ops_per_second": 5.0})
+
+
+# ---------------------------------------------------------------------------
+# mini-cluster e2e
+# ---------------------------------------------------------------------------
+
+
+def _free_port_pair():
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.fixture(autouse=True)
+def _jobs_hygiene():
+    saved = {k: getattr(retry.policy(), k)
+             for k in ("base_delay", "max_delay", "breaker_cooldown")}
+    retry.configure(base_delay=0.01, max_delay=0.1,
+                    breaker_cooldown=0.5)
+    retry.reset_breakers()
+    jobs_mod.configure(enabled=True)
+    yield
+    jobs_mod.configure(enabled=True)
+    retry.reset_breakers()
+    retry.configure(**saved)
+
+
+def _cluster(tmp_path_factory, n, **vs_kw):
+    master = MasterServer(port=_free_port_pair(),
+                          volume_size_limit_mb=64,
+                          pulse_seconds=PULSE, seed=42).start()
+    servers = []
+    for i in range(n):
+        d = tmp_path_factory.mktemp(f"jobs{i}")
+        servers.append(VolumeServer(
+            Store([d], max_volumes=8), port=_free_port_pair(),
+            master_url=master.url, pulse_seconds=PULSE,
+            job_poll_seconds=0.1, **vs_kw).start())
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.nodes) < n:
+        time.sleep(0.05)
+    assert len(master.topology.nodes) == n
+    return master, servers
+
+
+def _teardown(master, servers):
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+def _wait(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_distributed_ec_encode_sweep(tmp_path_factory):
+    """Two workers split a 4-volume sweep; the master's queue, the
+    /cluster/jobs view, the seaweed_jobs_* gauges, and the job-commit
+    cache-invalidation fan-out all agree."""
+    master, servers = _cluster(tmp_path_factory, 2)
+    mc = MasterClient(master.url)
+    remote_inval = invalidation.events.get("remote:ec_encode", 0)
+    try:
+        for _ in range(4):
+            master.grow_volume("sweep", "000")
+        time.sleep(2.5 * PULSE)
+        for i in range(24):
+            a = operation.assign(mc, collection="sweep")
+            operation.upload(a.url, a.fid, bytes([i]) * 2048,
+                             jwt=a.auth, collection="sweep")
+        job = master.jobs.submit(
+            "ec_encode", master.job_candidate_volumes("ec_encode",
+                                                      "sweep"),
+            collection="sweep", parallel=2)
+        assert job["total"] == 4
+        _wait(lambda: master.jobs.to_map(False)["jobs"][0]["state"]
+              == "done", 60, "sweep completion")
+        tasks = master.jobs.to_map()["jobs"][0]["tasks"]
+        assert {t["state"] for t in tasks} == {"done"}
+        # both workers participated (each owns 2 of the 4 volumes)
+        assert {t["worker"] for t in tasks} == \
+            {vs.url for vs in servers}
+        # every volume is EC-visible in the topology after heartbeats
+        _wait(lambda: len(master.topology.ec_locations) == 4, 10,
+              "EC shards in topology")
+        # exposition: gauges on the master's /metrics
+        with urllib.request.urlopen(
+                f"http://{master.url}/metrics") as r:
+            text = r.read().decode()
+        assert 'seaweed_jobs_tasks{kind="ec_encode",state="done"} 4'\
+            in text
+        # satellite: each commit fanned invalidation out to the OTHER
+        # server, whose /cache/invalidate funneled into the (process-
+        # global) registry
+        _wait(lambda: invalidation.events.get("remote:ec_encode", 0)
+              >= remote_inval + 4, 10, "cache invalidation fan-out")
+    finally:
+        mc.close()
+        _teardown(master, servers)
+
+
+def test_kill_switch_stops_handout(tmp_path_factory):
+    jm = JobManager(clock=FakeClock())
+    jm.submit("ec_encode", [1])
+    jobs_mod.configure(enabled=False)
+    try:
+        assert jm.claim(W1) is None
+    finally:
+        jobs_mod.configure(enabled=True)
+    assert jm.claim(W1) is not None
+
+
+def test_policy_loop_grows_then_shrinks_replica(tmp_path_factory):
+    """ISSUE 9 acceptance: hot reads on one volume -> policy submits
+    replicate -> /dir/lookup serves the new replica -> load stops ->
+    the replica is dropped back to the placement's copy count."""
+    master, servers = _cluster(tmp_path_factory, 2)
+    mc = MasterClient(master.url)
+    try:
+        # fast telemetry decay so the EWMA tracks the test's seconds-
+        # scale load pattern, then arm the policy engine
+        master.topology.telemetry.halflife = 0.5
+        master.policy.configure({
+            "policy": True, "policy_interval_seconds": 0.3,
+            "hot_read_ops_per_second": 2.0,
+            "cool_read_ops_per_second": 0.5,
+            "max_replicas": 2, "cooldown_seconds": 1.0})
+        a = operation.assign(mc, collection="hot")
+        want = b"hot-needle" * 200
+        operation.upload(a.url, a.fid, want, jwt=a.auth,
+                         collection="hot")
+        vid = int(a.fid.split(",")[0])
+        time.sleep(2.5 * PULSE)
+        assert len(mc.lookup(vid, "hot")) == 1
+
+        # zipfian-ish load: hammer the one hot needle
+        deadline = time.time() + 12
+        grown = False
+        while time.time() < deadline:
+            urllib.request.urlopen(
+                f"http://{a.url}/{a.fid}?collection=hot").read()
+            locs = master.lookup(vid, "hot")
+            if len(locs) == 2:
+                grown = True
+                break
+            time.sleep(0.02)
+        assert grown, "policy never grew the hot replica"
+        acts = [x["action"] for x in master.policy.actions]
+        assert "replicate" in acts
+        # the new replica serves reads through lookup
+        mc.invalidate()
+        assert operation.download(mc, a.fid, collection="hot") == want
+
+        # load stops -> EWMA decays below cool -> replica_drop
+        _wait(lambda: len(master.lookup(vid, "hot")) == 1, 20,
+              "replica shrink after cooldown")
+        assert "replica_drop" in \
+            [x["action"] for x in master.policy.actions]
+        # hysteresis held: exactly one grow and one shrink, no flap
+        acts = [x["action"] for x in master.policy.actions]
+        assert acts.count("replicate") == 1
+        assert acts.count("replica_drop") == 1
+    finally:
+        mc.close()
+        _teardown(master, servers)
