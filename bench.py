@@ -64,6 +64,7 @@ FAULT_TIMEOUT = 300      # fault-point-overhead stage (CPU mini cluster)
 PROFILE_TIMEOUT = 300    # profiler-overhead stage (CPU mini cluster)
 USAGE_TIMEOUT = 300      # usage-accounting-overhead stage (CPU mini cluster)
 JOBS_TIMEOUT = 300       # maintenance-plane-overhead stage (CPU mini cluster)
+INGRESS_TIMEOUT = 300    # ingress-admission-overhead stage (CPU mini cluster)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -254,6 +255,12 @@ def parent() -> None:
     rc, out = _run(["--child-jobs-overhead"], _scrubbed_env(),
                    JOBS_TIMEOUT)
     stage_platforms["jobs"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Ingress admission-control tax on the same path — same design.
+    rc, out = _run(["--child-ingress-overhead"], _scrubbed_env(),
+                   INGRESS_TIMEOUT)
+    stage_platforms["ingress"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1643,6 +1650,13 @@ elif sys.argv[2] == "jobs":
             _jobs.configure(enabled=enabled)
             master.policy.enabled = enabled
             master.policy.interval = 0.2
+elif sys.argv[2] == "ingress":
+    # on = the full admission path on every request (per-request
+    # counter, deadline-header parse, queue-pressure probe); off = the
+    # gate's disabled fast path. The worker pool, bounded queue and
+    # keep-alive core are structural and serve both modes identically,
+    # so the diff is exactly the per-request admission tax.
+    from seaweedfs_tpu.util import httpserver as plane
 else:  # "faults": on = armed-but-inert spec, so every fault point in
     # the read path pays the real armed cost (dict lookup miss) while
     # injecting nothing; off = the disarmed single-flag fast path.
@@ -1915,6 +1929,34 @@ def child_jobs_overhead() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_ingress_overhead() -> None:
+    """Ingress admission-control tax on the cached-read path
+    (docs/ingress.md).
+
+    Same paired-block harness as the observability stages; the stdin
+    toggle flips ``httpserver.configure(enabled=...)``, so "on" pays
+    the admission gate on every request (requests counter, deadline
+    parse, pressure probe against the dispatch queue) while "off"
+    takes the gate's single-flag fast path. The shared server core —
+    bounded worker pool, keep-alive parking — runs identically under
+    both modes, so the difference is the per-request admission cost.
+    Acceptance (ISSUE 10): overhead < 2%."""
+    t_off, t_on = _measure_plane_overhead("ingress")
+    overhead = (t_on - t_off) / t_off
+    res = {
+        "ingress_overhead_pct": round(overhead * 100, 2),
+        "ingress_read_us_off": round(t_off * 1e6, 1),
+        "ingress_read_us_on": round(t_on * 1e6, 1),
+        "ingress_overhead_ok": bool(overhead < 0.02),
+    }
+    log(f"ingress stage: cached read {res['ingress_read_us_off']}us "
+        f"off / {res['ingress_read_us_on']}us on -> "
+        f"{res['ingress_overhead_pct']}% overhead "
+        f"({'OK' if res['ingress_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -1949,5 +1991,8 @@ if __name__ == "__main__":
     elif ("--child-jobs-overhead" in sys.argv
           or "--jobs-overhead" in sys.argv):
         child_jobs_overhead()
+    elif ("--child-ingress-overhead" in sys.argv
+          or "--ingress-overhead" in sys.argv):
+        child_ingress_overhead()
     else:
         parent()

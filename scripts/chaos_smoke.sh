@@ -21,6 +21,11 @@
 #                      worker excluded, and the surviving replica
 #                      holder must finish the sweep with shard files
 #                      sha256-identical to a single-host encode.
+#   5. overload storm — a low-priority tenant saturates the S3
+#                      gateway at >4x its worker-pool capacity; the
+#                      guaranteed tenant must see zero failures, the
+#                      flood polite 429s, every shed accounted, the
+#                      thread pool pinned (scripts/ingress_smoke.sh).
 #
 #   bash scripts/chaos_smoke.sh [portBase] [workdir]
 set -euo pipefail
@@ -278,5 +283,8 @@ for vs in servers:
         pass
 master.stop()
 EOF
+
+say "scenario 5: overload storm (per-tenant QoS under saturation)"
+bash scripts/ingress_smoke.sh
 
 say "chaos smoke: ALL SCENARIOS PASSED"

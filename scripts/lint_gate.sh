@@ -73,5 +73,19 @@ if [ "$rc" -ne 0 ]; then
     echo >&2
     echo "lint_gate: jobs_smoke failed (exit $rc) — the leased-job" \
          "orchestration plane regressed; see scripts/jobs_smoke.sh" >&2
+    exit "$rc"
+fi
+
+# Overload smoke (docs/ingress.md): a low-priority tenant saturates
+# the S3 gateway at >4x pool capacity; the guaranteed tenant must see
+# zero failures, sheds must be polite 429s and fully accounted, and
+# the worker pool must hold its thread bound.
+bash scripts/ingress_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: ingress_smoke failed (exit $rc) — admission" \
+         "control or per-tenant QoS regressed; see" \
+         "scripts/ingress_smoke.sh" >&2
 fi
 exit "$rc"

@@ -15,7 +15,7 @@ import json
 import threading
 import time
 from concurrent import futures
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
@@ -29,6 +29,7 @@ from ..filer.stores import MemoryStore, SqliteStore
 from ..pb import filer_pb2
 from ..util import faults as faults_mod
 from ..util import glog
+from ..util import httpserver
 from ..util import profiler
 from ..util import retry
 from ..util import tracing
@@ -70,7 +71,7 @@ class FilerServer:
         self._usage_pusher: Optional[usage_mod.UsagePusher] = None
         self._conf_stop = threading.Event()
         self._grpc_server = None
-        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._http_server: Optional[httpserver.IngressHTTPServer] = None
         self._threads: list[threading.Thread] = []
 
     def _load_path_conf(self) -> None:
@@ -136,8 +137,8 @@ class FilerServer:
         self._grpc_server.start()
 
         handler = _make_http_handler(self)
-        self._http_server = ThreadingHTTPServer((self.ip, self.port),
-                                                handler)
+        self._http_server = httpserver.IngressHTTPServer(
+            (self.ip, self.port), handler, component="filer")
         t = threading.Thread(target=self._http_server.serve_forever,
                              daemon=True, name=f"filer-http-{self.port}")
         t.start()
@@ -395,7 +396,8 @@ def _make_http_handler(fs: FilerServer):
             if u.path == "/metrics":
                 self._send(200, (fs.metrics.render()
                                  + tracing.METRICS.render()
-                                 + retry.METRICS.render()).encode(),
+                                 + retry.METRICS.render()
+                                 + httpserver.METRICS.render()).encode(),
                            EXPOSITION_CONTENT_TYPE)
                 return
             if u.path == "/debug/traces":
@@ -599,7 +601,8 @@ def _make_http_handler(fs: FilerServer):
             self._send(204)
             fs.usage.record("anonymous", _bucket_of(path))
 
-    return tracing.instrument_http_handler(Handler, "filer")
+    return tracing.instrument_http_handler(
+        httpserver.admission_gate(Handler), "filer")
 
 
 def _parse_range(header, size: int):
@@ -669,6 +672,7 @@ def main(argv: list[str]) -> int:
     faults_mod.configure_from(conf)
     profiler.configure_from(conf)
     usage_mod.configure_from(conf)
+    httpserver.configure_from(conf)
     profiler.ensure_started()
     store = SqliteStore(args.db) if args.db else MemoryStore()
     filer = Filer(store)

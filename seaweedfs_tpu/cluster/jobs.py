@@ -591,6 +591,14 @@ class PolicyEngine:
     - **replica_drop** — read rate below ``cool_read_ops_per_second``
       and more copies than the placement requires: shrink back.
 
+    Chunk-cache warmth (cluster-wide hit ratio from telemetry) tilts
+    the decisions: a warm volume's observed read rate is mostly cache
+    hits, so sealing it to EC or dropping replicas would dump that
+    absorbed load back onto disks the moment caches churn. Volumes at
+    or above ``warm_cache_hit_ratio`` are never EC-encoded or shrunk,
+    and replicate already at ``cool_read_ops_per_second`` instead of
+    waiting for the hot threshold.
+
     Flap control is structural: grow and shrink thresholds are split
     (hysteresis band), every volume gets a ``cooldown_seconds`` dwell
     after any action, volumes with live tasks are skipped, and at most
@@ -608,6 +616,7 @@ class PolicyEngine:
         self.full_fraction = 0.9
         self.hot_read_rate = 50.0
         self.cool_read_rate = 10.0
+        self.warm_cache_ratio = 0.5
         self.max_replicas = 3
         self.cooldown = 120.0
         self.max_actions_per_tick = 2
@@ -636,6 +645,8 @@ class PolicyEngine:
                 s.get("hot_read_ops_per_second", self.hot_read_rate))
             self.cool_read_rate = float(
                 s.get("cool_read_ops_per_second", self.cool_read_rate))
+            self.warm_cache_ratio = float(
+                s.get("warm_cache_hit_ratio", self.warm_cache_ratio))
             self.max_replicas = int(
                 s.get("max_replicas", self.max_replicas))
             self.cooldown = float(
@@ -654,6 +665,7 @@ class PolicyEngine:
         """Fold topology + telemetry into one row per volume."""
         topo = self.master.topology
         rates = topo.telemetry.volume_read_rates()
+        warmth = topo.telemetry.volume_cache_warmth()
         rows: dict[int, dict] = {}
         for node in topo.snapshot_nodes():
             for (col, vid), v in node.volumes.items():
@@ -661,7 +673,9 @@ class PolicyEngine:
                     "volume_id": vid, "collection": col, "size": 0,
                     "read_only": False, "replicas": 0,
                     "placement": v.replica_placement,
-                    "read_rate": rates.get(vid, 0.0), "is_ec": False})
+                    "read_rate": rates.get(vid, 0.0),
+                    "cache_warmth": warmth.get(vid, 0.0),
+                    "is_ec": False})
                 r["replicas"] += 1
                 r["size"] = max(r["size"], v.size)
                 r["read_only"] = r["read_only"] or v.read_only
@@ -690,6 +704,8 @@ class PolicyEngine:
                 if now - self._last_action.get(vid, -1e18) < self.cooldown:
                     continue
                 rate = float(r.get("read_rate", 0.0))
+                warm = float(r.get("cache_warmth", 0.0)) \
+                    >= self.warm_cache_ratio
                 action = ""
                 if not r.get("is_ec"):
                     limit = int(r.get("limit", 0) or 0)
@@ -698,12 +714,15 @@ class PolicyEngine:
                         >= self.full_fraction * limit)
                     base = ReplicaPlacement.parse(
                         r.get("placement", "000")).copy_count()
-                    if full and rate <= self.cold_read_rate:
+                    grow_at = self.cool_read_rate if warm \
+                        else self.hot_read_rate
+                    if full and rate <= self.cold_read_rate \
+                            and not warm:
                         action = "ec_encode"
-                    elif (rate >= self.hot_read_rate
+                    elif (rate >= grow_at
                           and r.get("replicas", 1) < self.max_replicas):
                         action = "replicate"
-                    elif (rate <= self.cool_read_rate
+                    elif (rate <= self.cool_read_rate and not warm
                           and r.get("replicas", 1) > base):
                         action = "replica_drop"
                 if not action:
@@ -712,6 +731,8 @@ class PolicyEngine:
                 act = {"ts": now, "action": action, "volumeId": vid,
                        "collection": r.get("collection", ""),
                        "readRate": round(rate, 3),
+                       "cacheWarmth":
+                           round(float(r.get("cache_warmth", 0.0)), 3),
                        "replicas": r.get("replicas", 1)}
                 self.actions.append(act)
                 if self.jobs is not None:
@@ -758,6 +779,7 @@ class PolicyEngine:
                         "full_fraction": self.full_fraction,
                         "hot_read_ops_per_second": self.hot_read_rate,
                         "cool_read_ops_per_second": self.cool_read_rate,
+                        "warm_cache_hit_ratio": self.warm_cache_ratio,
                         "max_replicas": self.max_replicas,
                         "cooldown_seconds": self.cooldown,
                         "max_actions_per_tick":

@@ -21,7 +21,7 @@ import time
 import urllib.error
 from concurrent import futures
 from pathlib import Path
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
@@ -32,6 +32,7 @@ from ..storage.types import FileId
 from ..util import config as config_mod
 from ..util import faults as faults_mod
 from ..util import glog
+from ..util import httpserver
 from ..util import profiler
 from ..util import retry
 from ..util import security
@@ -144,7 +145,7 @@ class MasterServer:
         self._pusher = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
-        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._http_server: Optional[httpserver.IngressHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
         self._reaper: Optional[threading.Thread] = None
         self._vacuum_thread: Optional[threading.Thread] = None
@@ -194,7 +195,8 @@ class MasterServer:
         self._grpc_server.start()
 
         handler = _make_http_handler(self)
-        self._http_server = ThreadingHTTPServer((self.ip, self.port), handler)
+        self._http_server = httpserver.IngressHTTPServer(
+            (self.ip, self.port), handler, component="master")
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever, daemon=True,
             name=f"master-http-{self.port}")
@@ -540,16 +542,21 @@ class MasterServer:
                 for n in node_list:
                     seen[n.url] = n
                     shards.setdefault(n.url, []).append(sid)
+            # EC holders are ranked but never excluded: every node
+            # may hold shards that exist nowhere else, and a decode
+            # needs k distinct shards more than it needs fast ones
             out = [{"url": n.url,
                     "publicUrl": n.public_url or n.url,
                     "shards": shards[n.url]}
                    for n in self._rank_replicas(
-                       list(seen.values()), volume_id)]
+                       list(seen.values()), volume_id,
+                       exclude_unhealthy=False)]
             return out
         return [{"url": n.url, "publicUrl": n.public_url or n.url}
                 for n in self._rank_replicas(nodes, volume_id)]
 
-    def _rank_replicas(self, nodes: list, volume_id: int) -> list:
+    def _rank_replicas(self, nodes: list, volume_id: int,
+                       exclude_unhealthy: bool = True) -> list:
         """Telemetry-ranked read routing: healthy nodes first (then
         degraded, unhealthy last), and within a tier by health score
         plus a chunk-cache-warmth bonus for this volume — so clients
@@ -557,7 +564,14 @@ class MasterServer:
         only fall through to a faulted node at the tail. With no
         telemetry ingested every node scores 100/healthy and the
         topology's deterministic order is preserved (the sort is
-        stable)."""
+        stable).
+
+        Unhealthy-verdict nodes are *excluded* (not just demoted)
+        whenever at least one healthy/degraded replica exists —
+        handing a client a location the telemetry plane already
+        condemned only buys it a timeout before it falls through to
+        the next one anyway. The floor: a fully-degraded volume still
+        returns every location, because a slow answer beats none."""
         if len(nodes) < 2:
             return nodes
         tele = self.topology.telemetry
@@ -572,6 +586,12 @@ class MasterServer:
                    -(h["score"] + 25.0 * warmth), i)
             ranked.append((key, n))
         ranked.sort(key=lambda kn: kn[0])
+        alive = sum(1 for key, _n in ranked if key[0] < 2)
+        if exclude_unhealthy and 0 < alive < len(ranked):
+            self.metrics.counter(
+                "lookup_unhealthy_excluded_total").inc(
+                    len(ranked) - alive)
+            ranked = ranked[:alive]  # sort left unhealthy at the tail
         return [n for _key, n in ranked]
 
 
@@ -723,6 +743,8 @@ class _MasterServicer:
 
 def _make_http_handler(ms: MasterServer):
     class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # route through glog
             glog.v(2, "master http: " + fmt, *args)
 
@@ -822,7 +844,8 @@ def _make_http_handler(ms: MasterServer):
                             + ms.usage.metrics.render()
                             + ms.jobs.metrics.render()
                             + tracing.METRICS.render()
-                            + retry.METRICS.render()).encode()
+                            + retry.METRICS.render()
+                            + httpserver.METRICS.render()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      EXPOSITION_CONTENT_TYPE)
@@ -1053,7 +1076,8 @@ def _make_http_handler(ms: MasterServer):
             else:
                 self.do_GET()
 
-    return tracing.instrument_http_handler(Handler, "master")
+    return tracing.instrument_http_handler(
+        httpserver.admission_gate(Handler), "master")
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -1083,6 +1107,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     faults_mod.configure_from(conf)
     profiler.configure_from(conf)
     usage_mod.configure_from(conf)
+    httpserver.configure_from(conf)
     profiler.ensure_started()
     ms = MasterServer(ip=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,

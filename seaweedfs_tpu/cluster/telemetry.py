@@ -428,6 +428,25 @@ class ClusterTelemetry:
                         + agg.rates["read_ops"] * decay
             return out
 
+    def volume_cache_warmth(self) -> dict[int, float]:
+        """Cluster-wide per-volume cache hit ratio (hits over lookups,
+        summed across every node serving the volume). A warm volume's
+        reads are being absorbed by chunk caches, so its raw read rate
+        overstates the load the disks would take back if the policy
+        engine EC-encoded or shrank it — the maintenance plane feeds
+        this into its rows (satellite of PR 10, docs/jobs.md)."""
+        with self._lock:
+            hits: dict[int, int] = {}
+            looked: dict[int, int] = {}
+            for node in self._nodes.values():
+                for vid, agg in node.volumes.items():
+                    h = agg.cum["cache_hits"]
+                    m = agg.cum["cache_misses"]
+                    hits[vid] = hits.get(vid, 0) + h
+                    looked[vid] = looked.get(vid, 0) + h + m
+            return {vid: (hits[vid] / n if n else 0.0)
+                    for vid, n in looked.items()}
+
     def node_quantile(self, node_url: str, q: float,
                       read: bool = True) -> Optional[float]:
         """Merged latency quantile across a node's recent windows."""

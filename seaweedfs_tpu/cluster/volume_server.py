@@ -17,7 +17,7 @@ import json
 import threading
 import time
 from concurrent import futures
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from pathlib import Path
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -38,7 +38,8 @@ from ..storage.store import Store, StoreError
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
-from ..util import faults, glog, profiler, retry, security, tracing, varz
+from ..util import faults, glog, httpserver, profiler, retry, \
+    security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from ..cache import invalidation as invalidation_mod
 from . import jobs as jobs_mod
@@ -133,7 +134,7 @@ class VolumeServer:
         self.servicer: Optional["_VolumeServicer"] = None
         self._channels: dict[str, object] = {}
         self._grpc_server = None
-        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._http_server: Optional[httpserver.IngressHTTPServer] = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
@@ -163,7 +164,8 @@ class VolumeServer:
         self._grpc_server.start()
 
         handler = _make_http_handler(self)
-        self._http_server = ThreadingHTTPServer((self.ip, self.port), handler)
+        self._http_server = httpserver.IngressHTTPServer(
+            (self.ip, self.port), handler, component="volume")
         t = threading.Thread(target=self._http_server.serve_forever,
                              daemon=True, name=f"volume-http-{self.port}")
         t.start()
@@ -993,7 +995,8 @@ def _make_http_handler(vs: VolumeServer):
             if u.path == "/metrics":
                 self._send(200, (vs.metrics.render()
                                  + tracing.METRICS.render()
-                                 + retry.METRICS.render()).encode(),
+                                 + retry.METRICS.render()
+                                 + httpserver.METRICS.render()).encode(),
                            EXPOSITION_CONTENT_TYPE)
                 return
             if u.path == "/debug/traces":
@@ -1046,6 +1049,14 @@ def _make_http_handler(vs: VolumeServer):
                 self._send(200, data,
                            mime or "application/octet-stream")
                 vs.metrics.counter("read_requests", code="200").inc()
+            except faults.FaultDrop:
+                # Injected connection drop: no response, hard close.
+                # Answering 500 here would leave a healthy-looking
+                # keep-alive stream whose next pipelined request reads
+                # a response that was never meant to exist.
+                err = True
+                vs.metrics.counter("read_requests", code="drop").inc()
+                httpserver.drop_connection(self)
             except (KeyError, StoreError) as e:
                 vs.metrics.counter("read_requests", code="404").inc()
                 self._json({"error": str(e)}, 404)
@@ -1109,6 +1120,10 @@ def _make_http_handler(vs: VolumeServer):
                 self._json({"name": q.get("name", ""), "size": len(body)},
                            201)
                 vs.metrics.counter("write_requests", code="201").inc()
+            except faults.FaultDrop:
+                err = True
+                vs.metrics.counter("write_requests", code="drop").inc()
+                httpserver.drop_connection(self)
             except StoreError as e:
                 vs.metrics.counter("write_requests", code="404").inc()
                 self._json({"error": str(e)}, 404)
@@ -1148,7 +1163,8 @@ def _make_http_handler(vs: VolumeServer):
             except Exception as e:
                 self._json({"error": str(e)}, 500)
 
-    return tracing.instrument_http_handler(Handler, "volume")
+    return tracing.instrument_http_handler(
+        httpserver.admission_gate(Handler), "volume")
 
 
 def _replicate_http(peer_url: str, fid: str, body: Optional[bytes],
@@ -1200,6 +1216,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     retry.configure_from(conf)
     faults.configure_from(conf)
     profiler.configure_from(conf)
+    httpserver.configure_from(conf)
     profiler.ensure_started()
     from ..pipeline import pipe as pipe_mod
     pipe_mod.configure_from(conf)

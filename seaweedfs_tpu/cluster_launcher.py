@@ -121,6 +121,9 @@ class LocalCluster:
         # Gateways take TLS credentials via -securityConfig (on the s3
         # gateway, -config means identities JSON, not security.toml).
         gwsec = (["-securityConfig", self.config] if self.config else [])
+        # the same TOML also carries [ingress]/[qos]/[retry] for the
+        # gateways (their -config slot means identities JSON on s3)
+        gwsec += (["-toml", self.config] if self.config else [])
         if self.with_s3:
             self.procs["s3"] = _spawn(
                 ["s3", "-port", str(self.port_base + 300),

@@ -23,7 +23,7 @@ import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
 from ..cache import invalidation as invalidation_mod
@@ -31,6 +31,7 @@ from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
 from ..util import glog
+from ..util import httpserver
 from ..util import profiler
 from ..util import tracing
 from ..util import varz
@@ -85,7 +86,8 @@ class S3Gateway:
     def __init__(self, filer_url: str, ip: str = "127.0.0.1",
                  port: int = 8333,
                  identities: Optional[list[Identity]] = None,
-                 master_url: str = ""):
+                 master_url: str = "",
+                 qos: Optional[httpserver.QosEngine] = None):
         self.filer = FilerClient(filer_url)
         self.ip = ip
         self.port = port
@@ -102,7 +104,11 @@ class S3Gateway:
         self.static_identities = identities is not None
         self.auth = SigV4Verifier(identities)
         self.metrics = Metrics(namespace="s3")
-        self._http_server: Optional[ThreadingHTTPServer] = None
+        #: per-tenant QoS ladder ([qos] in the server TOML); None =
+        #: no classes configured, gateway sheds on raw pressure like
+        #: the other components
+        self.qos = qos
+        self._http_server: Optional[httpserver.IngressHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._conf_stop = threading.Event()
         self._conf_thread: Optional[threading.Thread] = None
@@ -185,8 +191,12 @@ class S3Gateway:
                 name=f"s3-conf-{self.port}")
             self._conf_thread.start()
         handler = _make_handler(self)
-        self._http_server = ThreadingHTTPServer((self.ip, self.port),
-                                                handler)
+        self._http_server = httpserver.IngressHTTPServer(
+            (self.ip, self.port), handler, component="s3")
+        # class-aware shedding replaces the generic pressure 429 (the
+        # admission gate skips it when .qos is set, so guaranteed
+        # tenants are never blind-shed before authentication)
+        self._http_server.qos = self.qos
         self._thread = threading.Thread(
             target=self._http_server.serve_forever, daemon=True,
             name=f"s3-{self.port}")
@@ -585,6 +595,14 @@ def _make_handler(gw: S3Gateway):
                 self.wfile.write(body)
 
         def _fail(self, exc) -> None:
+            if isinstance(exc, httpserver.QosShed):
+                # tenant over its class budget (or pressure-shed by
+                # the priority ladder): S3's throttling surface
+                self._send(429,
+                           _error_xml("SlowDown", str(exc), self.path),
+                           extra={"Retry-After":
+                                  str(max(1, int(exc.retry_after)))})
+                return
             if isinstance(exc, AuthError):
                 code, msg = exc.code, str(exc)
             elif isinstance(exc, S3Error):
@@ -612,6 +630,21 @@ def _make_handler(gw: S3Gateway):
                     f"for {ident.name}")
             return ident
 
+        def _qos(self, ident) -> Optional[httpserver.QosLease]:
+            """Class-aware admission, AFTER SigV4 so the tenant is the
+            authenticated identity. Raises QosShed (-> 429 SlowDown)
+            when the tenant's class is over budget or sheds under the
+            current queue pressure."""
+            srv = self.server
+            qos = getattr(srv, "qos", None)
+            if qos is None:
+                return None
+            pressure = srv.pressure() if hasattr(srv, "pressure") \
+                else 0.0
+            return qos.admit(
+                ident.name if ident is not None else "anonymous",
+                pressure)
+
         # -- verbs --
 
         def do_GET(self):
@@ -637,8 +670,10 @@ def _make_handler(gw: S3Gateway):
             ident = None
             n_out = 0
             err = False
+            lease = None
             try:
                 ident = self._auth(b"", "Read" if bucket else "", bucket)
+                lease = self._qos(ident)
                 if not bucket:
                     self._send(200, gw.list_buckets(ident))
                 elif not key:
@@ -670,6 +705,8 @@ def _make_handler(gw: S3Gateway):
                 err = True
                 self._fail(e)
             finally:
+                if lease is not None:
+                    lease.release()
                 gw.account(ident, bucket, key, n_out=n_out,
                            seconds=time.perf_counter() - t0, error=err)
 
@@ -677,8 +714,10 @@ def _make_handler(gw: S3Gateway):
             bucket, key, q, _ = self._split()
             ident = None
             err = False
+            lease = None
             try:
                 ident = self._auth(b"", "Read", bucket)
+                lease = self._qos(ident)
                 if not key:
                     gw._require_bucket(bucket)
                     self._send(200)
@@ -694,6 +733,8 @@ def _make_handler(gw: S3Gateway):
                 err = True
                 self._fail(e)
             finally:
+                if lease is not None:
+                    lease.release()
                 gw.account(ident, bucket, "", error=err)
 
         def do_PUT(self):
@@ -703,9 +744,11 @@ def _make_handler(gw: S3Gateway):
             t0 = time.perf_counter()
             ident = None
             err = False
+            lease = None
             try:
                 ident = self._auth(body, "Write" if key else "Admin",
                                    bucket)
+                lease = self._qos(ident)
                 if not key:
                     gw.create_bucket(bucket)
                     self._send(200)
@@ -734,6 +777,8 @@ def _make_handler(gw: S3Gateway):
                 err = True
                 self._fail(e)
             finally:
+                if lease is not None:
+                    lease.release()
                 gw.account(ident, bucket, key, n_in=len(body),
                            seconds=time.perf_counter() - t0, error=err)
 
@@ -756,8 +801,10 @@ def _make_handler(gw: S3Gateway):
             body = self._body()
             ident = None
             err = False
+            lease = None
             try:
                 ident = self._auth(body, "Write", bucket)
+                lease = self._qos(ident)
                 if "uploads" in q:
                     self._send(200, gw.initiate_multipart(bucket, key))
                 elif "uploadId" in q:
@@ -770,6 +817,8 @@ def _make_handler(gw: S3Gateway):
                 err = True
                 self._fail(e)
             finally:
+                if lease is not None:
+                    lease.release()
                 gw.account(ident, bucket, "", n_in=len(body),
                            error=err)
 
@@ -778,9 +827,11 @@ def _make_handler(gw: S3Gateway):
             gw.metrics.counter("request_total", method="DELETE").inc()
             ident = None
             err = False
+            lease = None
             try:
                 ident = self._auth(b"", "Write" if key else "Admin",
                                    bucket)
+                lease = self._qos(ident)
                 if "uploadId" in q:
                     gw.abort_multipart(q["uploadId"], bucket)
                     self._send(204)
@@ -794,9 +845,12 @@ def _make_handler(gw: S3Gateway):
                 err = True
                 self._fail(e)
             finally:
+                if lease is not None:
+                    lease.release()
                 gw.account(ident, bucket, "", error=err)
 
-    return tracing.instrument_http_handler(Handler, "s3")
+    return tracing.instrument_http_handler(
+        httpserver.admission_gate(Handler), "s3")
 
 
 def parse_identities(cfg: dict) -> list[Identity]:
@@ -833,13 +887,25 @@ def main(argv: list[str]) -> int:
                    help="master url to push usage accounting to")
     p.add_argument("-config", default="",
                    help="identities JSON (empty = open access)")
+    p.add_argument("-toml", default="",
+                   help="server TOML ([ingress], [qos], [retry])")
     from ..util import tls as tls_mod
     tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
     tls_mod.install_from_flag(args)
+    qos = None
+    if args.toml:
+        from ..util import config as config_mod
+        from ..util import retry as retry_mod
+        conf = config_mod.load(args.toml)
+        httpserver.configure_from(conf)
+        retry_mod.configure_from(conf)
+        tracing.configure_from(conf)
+        qos = httpserver.qos_from_conf(conf)
     idents = load_identities(args.config) if args.config else None
     gw = S3Gateway(args.filer, ip=args.ip, port=args.port,
-                   identities=idents, master_url=args.master).start()
+                   identities=idents, master_url=args.master,
+                   qos=qos).start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

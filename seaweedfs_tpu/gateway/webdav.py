@@ -12,7 +12,7 @@ import threading
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
 from ..cache import global_chunk_cache
@@ -20,6 +20,7 @@ from ..cache import invalidation as invalidation_mod
 from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..util import glog
+from ..util import httpserver
 from ..util import tracing
 
 DAV_NS = "DAV:"
@@ -56,13 +57,13 @@ class WebDavServer:
         # tenant; the hot-key sketch still attributes paths.
         self.usage = usage_mod.UsageCollector("webdav")
         self._usage_pusher: Optional[usage_mod.UsagePusher] = None
-        self._http_server: Optional[ThreadingHTTPServer] = None
+        self._http_server: Optional[httpserver.IngressHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     def start(self) -> "WebDavServer":
-        self._http_server = ThreadingHTTPServer(
-            (self.ip, self.port), _make_handler(self))
+        self._http_server = httpserver.IngressHTTPServer(
+            (self.ip, self.port), _make_handler(self), component="dav")
         self._thread = threading.Thread(
             target=self._http_server.serve_forever, daemon=True,
             name=f"webdav-{self.port}")
@@ -132,7 +133,8 @@ def _make_handler(dav: WebDavServer):
                   extra: Optional[dict] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
+            if not extra or "Content-Length" not in extra:
+                self.send_header("Content-Length", str(len(body)))
             for k, v in (extra or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -356,7 +358,8 @@ def _make_handler(dav: WebDavServer):
                 return
             self._send(201)
 
-    return tracing.instrument_http_handler(Handler, "dav")
+    return tracing.instrument_http_handler(
+        httpserver.admission_gate(Handler), "dav")
 
 
 def main(argv: list[str]) -> int:
@@ -371,10 +374,19 @@ def main(argv: list[str]) -> int:
                    help="filer directory served as the DAV root")
     p.add_argument("-master", default="",
                    help="master url to push usage snapshots to")
+    p.add_argument("-toml", default="",
+                   help="server TOML ([ingress], [retry])")
     from ..util import tls as tls_mod
     tls_mod.add_security_flag(p)
     args = p.parse_args(argv)
     tls_mod.install_from_flag(args)
+    if args.toml:
+        from ..util import config as config_mod
+        from ..util import retry as retry_mod
+        conf = config_mod.load(args.toml)
+        httpserver.configure_from(conf)
+        retry_mod.configure_from(conf)
+        tracing.configure_from(conf)
     srv = WebDavServer(args.filer, ip=args.ip, port=args.port,
                        root=args.root,
                        master_url=args.master).start()

@@ -1793,6 +1793,38 @@ def cmd_trace_status(env: ClusterEnv, argv: list[str]) -> None:
                     f"slow_threshold={d['slow_threshold_seconds']}s")
 
 
+@cluster_command("ingress.status")
+def cmd_ingress_status(env: ClusterEnv, argv: list[str]) -> None:
+    """Per-server ingress-plane state (worker pool, queue pressure,
+    parked keep-alive connections, shed counters), polled from each
+    server's /debug/vars."""
+    p = _parser("ingress.status")
+    p.parse_args(argv)
+    for role, host in _trace_hosts(env):
+        try:
+            d = env._master_http("/debug/vars", host=host)
+        except ShellError as e:
+            env.println(f"{role} {host}: unreachable ({e})")
+            continue
+        ing = d.get("ingress") or {}
+        servers = ing.get("servers") or []
+        if not servers:
+            env.println(f"{role} {host}: no ingress servers")
+            continue
+        for s in servers:
+            env.println(
+                f"{role} {host}: [{s['component']}] "
+                f"busy={s['busy']}/{s['workers']} "
+                f"queued={s['queued']}/{s['queue_depth']} "
+                f"pressure={s['pressure']:.2f} "
+                f"conns={s['connections']}/{s['max_connections']} "
+                f"parked={s['parked']} served={s['served_total']}")
+        shed = ing.get("shed") or {}
+        if shed:
+            env.println(f"{role} {host}: shed " + " ".join(
+                f"{k}={v}" for k, v in sorted(shed.items())))
+
+
 @cluster_command("trace.dump")
 def cmd_trace_dump(env: ClusterEnv, argv: list[str]) -> None:
     """Span trees of recent traces across the cluster. With -traceId,

@@ -86,6 +86,58 @@ failover_budget_seconds = 5.0    # cap on waiting out a master election
 [retry.breaker]
 failure_threshold = 5            # consecutive failures -> open
 cooldown_seconds = 5.0           # open -> half-open probe delay
+
+[retry.pool]
+max_idle_per_host = 4            # parked keep-alive sockets per host
+idle_seconds = 30.0              # parked longer than this -> redial
+""",
+    "ingress": """\
+# ingress.toml — overload-resilient server core (docs/ingress.md).
+# Applies to every HTTP listener (master, volume, filer, s3, webdav).
+[ingress]
+enabled = true                   # false = admit everything (bench A/B)
+workers = 16                     # request-servicing threads per server
+queue_depth = 64                 # dispatch backlog driving `pressure`
+max_connections = 512            # accept cap; beyond it -> raw 429
+keepalive_idle_seconds = 15.0    # parked idle conns reaped after this
+keepalive_max_requests = 1000    # requests per connection before close
+request_read_timeout_seconds = 30.0
+shed_watermark = 0.75            # pressure >= this -> 429 Retry-After
+retry_after_seconds = 1.0        # Retry-After hint on pressure sheds
+min_deadline_seconds = 0.0       # X-Seaweed-Deadline <= this -> 504
+""",
+    "qos": """\
+# qos.toml — per-tenant QoS at the S3 gateway (docs/ingress.md).
+# Tenants are authenticated SigV4 identity names; unauthenticated
+# traffic is the "anonymous" tenant. Priority 0 = guaranteed (never
+# pressure-shed); higher priorities shed earlier as queue pressure
+# rises (class threshold = watermark ** priority).
+[qos]
+enabled = true
+default_class = "standard"       # class for unmapped tenants
+watermark = 0.75                 # base of the priority shed ladder
+
+[qos.class.gold]
+priority = 0                     # guaranteed: only its own caps apply
+rate_per_second = 0              # token-bucket refill; 0 = unlimited
+burst = 0                        # bucket size; 0 = max(1, rate)
+concurrency = 0                  # in-flight cap; 0 = unlimited
+
+[qos.class.standard]
+priority = 1
+rate_per_second = 0
+burst = 0
+concurrency = 0
+
+[qos.class.bronze]
+priority = 2
+rate_per_second = 50
+burst = 100
+concurrency = 16
+
+[qos.tenant]
+# alice = "gold"                 # identity name -> class name
+# mallory = "bronze"
 """,
     "pipeline": """\
 # pipeline.toml — overlapped EC ingest plane (docs/pipeline.md).

@@ -35,6 +35,8 @@ Config lives in a ``[retry]`` TOML block (see ``config.SCAFFOLDS``).
 
 from __future__ import annotations
 
+import http.client
+import io
 import random
 import threading
 import time
@@ -143,6 +145,12 @@ def configure_from(conf: dict) -> None:
             conf, "retry.breaker.failure_threshold"),
         breaker_cooldown=config_mod.lookup(
             conf, "retry.breaker.cooldown_seconds"))
+    v = config_mod.lookup(conf, "retry.pool.max_idle_per_host")
+    if v is not None:
+        _POOL.max_idle_per_host = int(v)
+    v = config_mod.lookup(conf, "retry.pool.idle_seconds")
+    if v is not None:
+        _POOL.idle_seconds = float(v)
 
 
 # --------------------------------------------------------------------------
@@ -346,6 +354,173 @@ def reset_breakers() -> None:
 
 
 # --------------------------------------------------------------------------
+# client connection pooling
+# --------------------------------------------------------------------------
+
+class _IdleConn:
+    __slots__ = ("conn", "last_used")
+
+    def __init__(self, conn):
+        self.conn = conn
+        self.last_used = time.monotonic()
+
+
+class ConnectionPool:
+    """Persistent ``http.client`` connections keyed by ``host:port``.
+
+    Every intra-cluster hop (gateway -> filer -> master -> volume)
+    used to pay a fresh TCP handshake per request because urllib sends
+    ``Connection: close``. With the ingress core speaking real
+    HTTP/1.1 keep-alive, the client side can finally hold sockets
+    open: release() parks a clean connection, acquire() hands it back
+    for the next request to the same endpoint. Stale sockets (server
+    reaped the idle connection first) surface as an immediate
+    RemoteDisconnected and cost one transparent redial, never a
+    user-visible failure.
+    """
+
+    def __init__(self, max_idle_per_host: int = 4,
+                 idle_seconds: float = 30.0):
+        self.max_idle_per_host = max_idle_per_host
+        self.idle_seconds = idle_seconds
+        self._idle: dict[str, list[_IdleConn]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, netloc: str, timeout: float):
+        """-> (connection, reused). The caller owns the connection
+        until release()/discard()."""
+        now = time.monotonic()
+        while True:
+            with self._lock:
+                stack = self._idle.get(netloc)
+                ic = stack.pop() if stack else None
+            if ic is None:
+                break
+            conn = ic.conn
+            if now - ic.last_used > self.idle_seconds \
+                    or conn.sock is None:
+                self.discard(conn)
+                continue
+            try:
+                conn.sock.settimeout(timeout)
+            except OSError:
+                self.discard(conn)
+                continue
+            METRICS.counter("pool_reuse_total").inc()
+            return conn, True
+        host, _, port = netloc.partition(":")
+        conn = http.client.HTTPConnection(
+            host, int(port) if port else 80, timeout=timeout)
+        METRICS.counter("pool_dial_total").inc()
+        return conn, False
+
+    def release(self, netloc: str, conn) -> None:
+        """Park a connection whose response was fully read."""
+        with self._lock:
+            stack = self._idle.setdefault(netloc, [])
+            if len(stack) < self.max_idle_per_host:
+                stack.append(_IdleConn(conn))
+                return
+        self.discard(conn)
+
+    def discard(self, conn) -> None:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001  # seaweedlint: disable=SW301 — discarding a dead connection
+            pass
+
+    def clear(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, {}
+        for stack in idle.values():
+            for ic in stack:
+                self.discard(ic.conn)
+
+    def idle_count(self, netloc: Optional[str] = None) -> int:
+        with self._lock:
+            if netloc is not None:
+                return len(self._idle.get(netloc, ()))
+            return sum(len(s) for s in self._idle.values())
+
+    def payload(self) -> dict:
+        """The ``http_pool`` section of ``/debug/vars``."""
+        with self._lock:
+            return {"max_idle_per_host": self.max_idle_per_host,
+                    "idle_seconds": self.idle_seconds,
+                    "idle": {k: len(v) for k, v in self._idle.items()
+                             if v}}
+
+
+_POOL = ConnectionPool()
+
+#: Sockets that died between requests (server closed the idle keep-
+#: alive first): retried once on a fresh dial without consuming an
+#: attempt or tripping the breaker — the endpoint never saw it.
+_STALE_ERRORS = (http.client.RemoteDisconnected,
+                 http.client.CannotSendRequest, ConnectionResetError,
+                 ConnectionAbortedError, BrokenPipeError)
+
+
+def pool() -> ConnectionPool:
+    return _POOL
+
+
+def close_pool() -> None:
+    """Drop every idle pooled connection (tests, fault drills)."""
+    _POOL.clear()
+
+
+def _pooled_request(url: str, netloc: str, selector: str, method: str,
+                    data: Optional[bytes], hdrs: dict,
+                    timeout: float, point: str):
+    """One wire exchange over a pooled connection. Returns
+    ``(status, headers, body)``; raises ``HTTPError`` for >= 400 (body
+    attached, connection still reusable — the endpoint answered) and
+    wraps transport errors in ``URLError`` so callers' existing
+    ``except urllib.error.*`` clauses keep working."""
+    stale_redial = False
+    while True:
+        conn, reused = _POOL.acquire(netloc, timeout)
+        try:
+            # The armed fault point fires while holding the pooled
+            # connection: a ``drop`` kills *this* socket, exactly like
+            # a peer reset would, instead of poisoning the pool.
+            faults.check(point)
+            conn.request(method, selector, body=data, headers=hdrs)
+            resp = conn.getresponse()
+            body = resp.read()
+        except faults.FaultError:
+            _POOL.discard(conn)
+            raise
+        except Exception as e:  # noqa: BLE001 — transport layer
+            _POOL.discard(conn)
+            if reused and not stale_redial \
+                    and isinstance(e, _STALE_ERRORS):
+                stale_redial = True
+                METRICS.counter("pool_stale_redial_total").inc()
+                continue
+            if isinstance(e, urllib.error.URLError):
+                raise
+            raise urllib.error.URLError(e) from e
+        mangled = faults.mangle(point, body)
+        if mangled is not body:
+            # truncate/corrupt actions simulate a wire cut mid-body;
+            # a connection that "lost" bytes must not serve the next
+            # pipelined request.
+            _POOL.discard(conn)
+            body = mangled
+        elif resp.will_close:
+            _POOL.discard(conn)
+        else:
+            _POOL.release(netloc, conn)
+        if resp.status >= 400:
+            raise urllib.error.HTTPError(
+                url, resp.status, resp.reason, resp.headers,
+                io.BytesIO(body))
+        return resp.status, resp.headers, body
+
+
+# --------------------------------------------------------------------------
 # degraded-read accounting
 # --------------------------------------------------------------------------
 
@@ -406,24 +581,35 @@ def http_request(url: str, data: Optional[bytes] = None,
                             point=label).inc()
             raise BreakerOpenError(brk.key) from last
         try:
-            faults.check(point)
             hdrs = dict(headers) if headers else {}
             inject(hdrs, dl)
             if jwt:
                 hdrs["Authorization"] = f"BEARER {jwt}"
-            req = urllib.request.Request(url, data=data, method=method,
-                                         headers=hdrs)
             att_timeout = min(pol.timeout if timeout is None
                               else timeout, dl.remaining())
             if att_timeout <= 0:
                 raise DeadlineExceeded(
                     f"deadline exhausted before attempt {attempt + 1} "
                     f"of {method or 'GET'} {url}")
-            with urllib.request.urlopen(req, timeout=att_timeout) as r:
-                body = r.read()
-                status = r.status
-                resp_headers = r.headers
-            body = faults.mangle(point, body)
+            parts = urllib.parse.urlsplit(url)
+            if parts.scheme == "http":
+                selector = parts.path or "/"
+                if parts.query:
+                    selector += "?" + parts.query
+                status, resp_headers, body = _pooled_request(
+                    url, parts.netloc, selector,
+                    method or ("POST" if data is not None else "GET"),
+                    data, hdrs, att_timeout, point)
+            else:
+                faults.check(point)
+                req = urllib.request.Request(
+                    url, data=data, method=method, headers=hdrs)
+                with urllib.request.urlopen(
+                        req, timeout=att_timeout) as r:
+                    body = r.read()
+                    status = r.status
+                    resp_headers = r.headers
+                body = faults.mangle(point, body)
             if brk is not None:
                 brk.record_success()
             return HttpResponse(status, resp_headers, body)

@@ -37,6 +37,15 @@ def _pipeline_payload() -> dict:
     return mod.debug_payload()
 
 
+def _ingress_payload() -> dict:
+    # lazy for the same reason — and httpserver imports stats only,
+    # so this stays cheap even when no IngressHTTPServer exists
+    mod = sys.modules.get("seaweedfs_tpu.util.httpserver")
+    if mod is None:
+        return {}
+    return mod.debug_payload()
+
+
 def _rss_bytes() -> Optional[int]:
     # /proc is authoritative on linux; ru_maxrss is a peak, not current
     try:
@@ -71,6 +80,8 @@ def payload(component: str, metrics: Optional[Metrics] = None,
         "faults": faults.debug_payload(),
         "profiler": profiler.debug_payload(),
         "pipeline": _pipeline_payload(),
+        "ingress": _ingress_payload(),
+        "http_pool": retry.pool().payload(),
     }
     rss = _rss_bytes()
     if rss is not None:

@@ -1,0 +1,527 @@
+"""Ingress-plane tests (PR 10): the shared server core, admission
+control, per-tenant QoS, and the pooled keep-alive client.
+
+Unit tests drive the admission/QoS decision logic with fake clocks and
+stubbed pressure; the e2e tests boot a real :class:`IngressHTTPServer`
+on a loopback port and speak HTTP/1.1 keep-alive at it with
+``http.client`` (urllib always sends ``Connection: close``, which
+would bypass exactly the machinery under test).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from seaweedfs_tpu.util import httpserver, retry
+from seaweedfs_tpu.util.httpserver import (
+    AdmissionController, IngressConfig, IngressHTTPServer, QosClass,
+    QosEngine, QosShed, TokenBucket, qos_from_conf,
+)
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    #: per-class knobs the tests flip
+    delay = 0.0
+    barrier: "threading.Event | None" = None
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.barrier is not None:
+            self.barrier.wait(5.0)
+        if self.delay:
+            time.sleep(self.delay)
+        if self.path == "/drop":
+            httpserver.drop_connection(self)
+            return
+        body = b"ok:" + self.path.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_POST = do_GET
+
+
+def _serve(handler_cls=None, **cfg):
+    """Boot an IngressHTTPServer on an ephemeral port; caller closes."""
+    cls = handler_cls or _EchoHandler
+    srv = IngressHTTPServer(
+        ("127.0.0.1", 0), httpserver.admission_gate(cls),
+        config=IngressConfig(**cfg), component="test")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def _get(port: int, path: str = "/", headers=None):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        c.request("GET", path, headers=headers or {})
+        r = c.getresponse()
+        return r.status, r.read(), dict(r.getheaders())
+    finally:
+        c.close()
+
+
+# --------------------------------------------------------------------------
+# token bucket
+# --------------------------------------------------------------------------
+
+def test_token_bucket_refill():
+    now = [100.0]
+    b = TokenBucket(rate=2.0, burst=4.0, clock=lambda: now[0])
+    # burst drains first, then empty
+    assert [b.take() for _ in range(4)] == [0.0] * 4
+    wait = b.take()
+    assert wait == pytest.approx(0.5)  # 1 token at 2/s
+    # half a second later exactly one token has refilled
+    now[0] += 0.5
+    assert b.take() == 0.0
+    assert b.take() > 0.0
+    # refill never exceeds burst
+    now[0] += 1000.0
+    assert [b.take() for _ in range(4)] == [0.0] * 4
+    assert b.take() > 0.0
+
+
+def test_token_bucket_zero_rate_never_grants_after_burst():
+    now = [0.0]
+    b = TokenBucket(rate=0.0, burst=2.0, clock=lambda: now[0])
+    assert b.take() == 0.0 and b.take() == 0.0
+    now[0] += 1e6
+    assert b.take() > 0.0  # nothing ever refills
+
+
+# --------------------------------------------------------------------------
+# QoS engine
+# --------------------------------------------------------------------------
+
+def _engine(**kw):
+    classes = {
+        "gold": QosClass("gold", priority=0),
+        "standard": QosClass("standard", priority=1),
+        "bronze": QosClass("bronze", priority=2),
+    }
+    tenants = {"alice": "gold", "bob": "standard", "mallory": "bronze"}
+    return QosEngine(classes=classes, tenants=tenants,
+                     default_class="standard", watermark=0.75, **kw)
+
+
+def test_qos_priority_ladder():
+    q = _engine()
+    # thresholds: gold=inf, standard=0.75, bronze=0.5625
+    assert q.shed_threshold(q.class_of("alice")) == float("inf")
+    assert q.shed_threshold(q.class_of("bob")) == pytest.approx(0.75)
+    assert q.shed_threshold(q.class_of("mallory")) == \
+        pytest.approx(0.75 ** 2)
+    # at pressure 0.6 only the lowest class sheds
+    q.admit("alice", pressure=0.6).release()
+    q.admit("bob", pressure=0.6).release()
+    with pytest.raises(QosShed) as ei:
+        q.admit("mallory", pressure=0.6)
+    assert ei.value.reason == "pressure"
+    assert ei.value.class_name == "bronze"
+    # at pressure 0.8 standard sheds too; guaranteed never does
+    with pytest.raises(QosShed):
+        q.admit("bob", pressure=0.8)
+    q.admit("alice", pressure=1.0).release()
+
+
+def test_qos_unknown_tenant_gets_default_class():
+    q = _engine()
+    assert q.class_of("stranger").name == "standard"
+
+
+def test_qos_rate_limit_and_retry_after():
+    now = [0.0]
+    q = QosEngine(classes={"c": QosClass("c", priority=1, rate=1.0,
+                                         burst=2.0)},
+                  tenants={"t": "c"}, default_class="c",
+                  clock=lambda: now[0])
+    q.admit("t").release()
+    q.admit("t").release()
+    with pytest.raises(QosShed) as ei:
+        q.admit("t")
+    assert ei.value.reason == "rate"
+    assert ei.value.retry_after >= 1.0
+    now[0] += 1.0  # one token refilled
+    q.admit("t").release()
+
+
+def test_qos_concurrency_cap_and_lease_release():
+    q = QosEngine(classes={"c": QosClass("c", concurrency=2)},
+                  tenants={"t": "c"}, default_class="c")
+    l1 = q.admit("t")
+    l2 = q.admit("t")
+    with pytest.raises(QosShed) as ei:
+        q.admit("t")
+    assert ei.value.reason == "concurrency"
+    l1.release()
+    l1.release()  # idempotent: must not free a second slot
+    l3 = q.admit("t")
+    with pytest.raises(QosShed):
+        q.admit("t")
+    l2.release()
+    l3.release()
+    assert q.payload()["inflight"] == {}
+
+
+def test_qos_from_conf_roundtrip():
+    conf = {"qos": {
+        "enabled": True, "default_class": "std", "watermark": 0.5,
+        "class": {
+            "gold": {"priority": 0},
+            "std": {"priority": 1, "rate_per_second": 10,
+                    "burst": 20, "concurrency": 8},
+        },
+        "tenant": {"alice": "gold"},
+    }}
+    q = qos_from_conf(conf)
+    assert q is not None
+    assert q.class_of("alice").priority == 0
+    std = q.class_of("anyone")
+    assert (std.name, std.rate, std.burst, std.concurrency) == \
+        ("std", 10.0, 20.0, 8)
+    assert q.watermark == 0.5
+    assert qos_from_conf({"qos": {"enabled": False}}) is None
+    assert qos_from_conf({}) is None
+
+
+# --------------------------------------------------------------------------
+# admission controller (unit: stub server/handler)
+# --------------------------------------------------------------------------
+
+class _StubServer:
+    def __init__(self, pressure=0.0, qos=None, **cfg):
+        self.config = IngressConfig(**cfg)
+        self.qos = qos
+        self._pressure = pressure
+        self.admission = AdmissionController(self)
+
+    def pressure(self):
+        return self._pressure
+
+
+class _StubHandler:
+    def __init__(self, path="/x", headers=None):
+        self.path = path
+        self.headers = headers or {}
+
+
+def test_admission_expired_deadline_sheds():
+    srv = _StubServer()
+    dec = srv.admission.check(_StubHandler(
+        headers={httpserver.DEADLINE_HEADER: "0"}))
+    assert dec is not None and dec[0] == 504 and dec[1] == "deadline"
+    # live budget passes
+    assert srv.admission.check(_StubHandler(
+        headers={httpserver.DEADLINE_HEADER: "5.0"})) is None
+    # garbled header is ignored, not shed
+    assert srv.admission.check(_StubHandler(
+        headers={httpserver.DEADLINE_HEADER: "soon"})) is None
+
+
+def test_admission_pressure_watermark():
+    srv = _StubServer(pressure=0.8, shed_watermark=0.75)
+    dec = srv.admission.check(_StubHandler())
+    assert dec is not None and dec[0] == 429 and dec[1] == "pressure"
+    assert _StubServer(pressure=0.5).admission.check(
+        _StubHandler()) is None
+    # debug/health endpoints are exempt however hot the queue is
+    assert srv.admission.check(_StubHandler("/debug/vars")) is None
+    assert srv.admission.check(_StubHandler("/metrics")) is None
+
+
+def test_admission_defers_pressure_to_qos():
+    # an S3 server with a QoS engine sheds class-aware AFTER auth;
+    # the pre-auth gate must not blind-shed its guaranteed tenants
+    srv = _StubServer(pressure=1.0, qos=QosEngine())
+    assert srv.admission.check(_StubHandler()) is None
+    # deadline shedding still applies either way
+    dec = srv.admission.check(_StubHandler(
+        headers={httpserver.DEADLINE_HEADER: "0"}))
+    assert dec is not None and dec[0] == 504
+
+
+# --------------------------------------------------------------------------
+# e2e: real server on a loopback port
+# --------------------------------------------------------------------------
+
+def test_keepalive_reuses_connection():
+    srv, port = _serve()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        for i in range(5):
+            c.request("GET", f"/r{i}")
+            r = c.getresponse()
+            assert r.status == 200 and r.read() == b"ok:/r%d" % i
+        st = srv.stats_payload()
+        assert st["served_total"] == 5
+        assert st["connections"] == 1  # one socket served all five
+        c.close()
+    finally:
+        srv.server_close()
+
+
+def test_deadline_504_then_connection_survives():
+    srv, port = _serve()
+    try:
+        before = httpserver.shed_counts().get("deadline|anonymous", 0)
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/x",
+                  headers={httpserver.DEADLINE_HEADER: "0"})
+        r = c.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 504 and body["reason"] == "deadline"
+        # a shed is a polite answer: same connection keeps working
+        c.request("GET", "/y")
+        r = c.getresponse()
+        assert r.status == 200 and r.read() == b"ok:/y"
+        c.close()
+        after = httpserver.shed_counts().get("deadline|anonymous", 0)
+        assert after == before + 1
+    finally:
+        srv.server_close()
+
+
+def test_pressure_429_has_retry_after():
+    srv, port = _serve()
+    try:
+        srv.pressure = lambda: 1.0  # saturate without racing a pool
+        status, body, headers = _get(port, "/x")
+        assert status == 429
+        assert json.loads(body)["reason"] == "pressure"
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        srv.server_close()
+
+
+def test_connection_cap_rejects_with_raw_429():
+    srv, port = _serve(max_connections=1, workers=2)
+    try:
+        hold = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        hold.request("GET", "/a")
+        assert hold.getresponse().read() == b"ok:/a"
+        # the held keep-alive socket occupies the only slot
+        status, _, headers = _get(port, "/b")
+        assert status == 429
+        assert headers.get("Connection", "").lower() == "close"
+        hold.close()
+    finally:
+        srv.server_close()
+
+
+def test_idle_connections_reaped():
+    srv, port = _serve(keepalive_idle_seconds=0.2)
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/a")
+        assert c.getresponse().read() == b"ok:/a"
+        deadline = time.time() + 5
+        while srv.stats_payload()["connections"] and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert srv.stats_payload()["connections"] == 0
+        # the socket is gone server-side: a new request fails
+        with pytest.raises((http.client.HTTPException, OSError)):
+            c.request("GET", "/b")
+            c.getresponse()
+        c.close()
+    finally:
+        srv.server_close()
+
+
+def test_keepalive_max_requests_closes_politely():
+    srv, port = _serve(keepalive_max_requests=2)
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/a")
+        assert c.getresponse().read() == b"ok:/a"
+        c.request("GET", "/b")
+        r = c.getresponse()
+        assert r.read() == b"ok:/b"
+        deadline = time.time() + 5
+        while srv.stats_payload()["connections"] and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert srv.stats_payload()["connections"] == 0
+        c.close()
+    finally:
+        srv.server_close()
+
+
+def test_drop_connection_closes_without_response():
+    srv, port = _serve()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/drop")
+        with pytest.raises((http.client.HTTPException, OSError)):
+            c.getresponse()
+        c.close()
+        # server is healthy for the next (fresh) connection
+        status, body, _ = _get(port, "/ok")
+        assert status == 200 and body == b"ok:/ok"
+    finally:
+        srv.server_close()
+
+
+def test_saturated_pool_never_exceeds_thread_bound():
+    """ISSUE 10 satellite: drive 8x the pool width in concurrent
+    requests; the worker-thread count stays at the configured bound
+    and every request is eventually answered (served or shed)."""
+
+    class Slow(_EchoHandler):
+        delay = 0.05
+
+    srv, port = _serve(Slow, workers=4, queue_depth=8,
+                       max_connections=64)
+    try:
+        results: list = []
+
+        def one(i):
+            try:
+                results.append(_get(port, f"/s{i}")[0])
+            except Exception as e:  # noqa: BLE001
+                results.append(e)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        peak_workers = 0
+        deadline = time.time() + 10
+        while any(t.is_alive() for t in threads) and \
+                time.time() < deadline:
+            n = sum(1 for th in threading.enumerate()
+                    if th.name.startswith("ingress-test-w"))
+            peak_workers = max(peak_workers, n)
+            busy = srv.stats_payload()["busy"]
+            assert busy <= 4, f"busy {busy} exceeds worker bound"
+            time.sleep(0.005)
+        for t in threads:
+            t.join(5)
+        assert peak_workers <= 4
+        # nothing hung: every request got SOME well-formed answer
+        assert len(results) == 32
+        assert all(isinstance(s, int) and s in (200, 429, 504)
+                   for s in results), results
+        st = srv.stats_payload()
+        assert st["workers"] == 4
+    finally:
+        srv.server_close()
+
+
+def test_debug_payload_lists_server():
+    srv, _port = _serve()
+    try:
+        payload = httpserver.debug_payload()
+        comps = [s["component"] for s in payload["servers"]]
+        assert "test" in comps
+        row = next(s for s in payload["servers"]
+                   if s["component"] == "test")
+        for k in ("workers", "busy", "queued", "pressure",
+                  "connections", "parked", "served_total"):
+            assert k in row
+    finally:
+        srv.server_close()
+
+
+# --------------------------------------------------------------------------
+# pooled client (util/retry.py)
+# --------------------------------------------------------------------------
+
+def test_client_pool_reuses_connections():
+    srv, port = _serve()
+    retry.close_pool()
+    try:
+        url = f"http://127.0.0.1:{port}/p"
+        for _ in range(4):
+            r = retry.http_request(url)
+            assert r.status == 200 and r.data == b"ok:/p"
+        # server saw ONE connection carry all four requests
+        assert srv.stats_payload()["connections"] == 1
+        assert retry.pool().idle_count(f"127.0.0.1:{port}") == 1
+    finally:
+        retry.close_pool()
+        srv.server_close()
+
+
+def test_client_pool_redials_after_server_reap():
+    srv, port = _serve(keepalive_idle_seconds=0.15)
+    retry.close_pool()
+    try:
+        url = f"http://127.0.0.1:{port}/p"
+        assert retry.http_request(url).status == 200
+        # wait for the server to reap the parked connection
+        deadline = time.time() + 5
+        while srv.stats_payload()["connections"] and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        # the pooled socket is stale; the client redials transparently
+        assert retry.http_request(url).status == 200
+    finally:
+        retry.close_pool()
+        srv.server_close()
+
+
+def test_client_pool_keeps_connection_after_http_error():
+    class NotFound(_EchoHandler):
+        def do_GET(self):
+            body = b"missing"
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv, port = _serve(NotFound)
+    retry.close_pool()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            retry.http_request(f"http://127.0.0.1:{port}/x")
+        assert ei.value.code == 404
+        assert ei.value.read() == b"missing"
+        # the error body was fully drained, so the conn was reusable
+        assert retry.pool().idle_count(f"127.0.0.1:{port}") == 1
+    finally:
+        retry.close_pool()
+        srv.server_close()
+
+
+# --------------------------------------------------------------------------
+# config plumbing
+# --------------------------------------------------------------------------
+
+def test_configure_from_ingress_section():
+    saved = httpserver.default_config().to_dict()
+    try:
+        httpserver.configure_from({"ingress": {
+            "workers": 3, "queue_depth": 5, "shed_watermark": 0.5,
+            "request_read_timeout_seconds": 7.5}})
+        d = httpserver.default_config().to_dict()
+        assert (d["workers"], d["queue_depth"]) == (3, 5)
+        assert d["shed_watermark"] == 0.5
+        assert d["request_read_timeout"] == 7.5
+    finally:
+        httpserver.configure(**saved)
+
+
+def test_scaffolds_parse_with_subset_parser():
+    from seaweedfs_tpu.util import config as config_mod
+    ing = config_mod._parse_toml_subset(config_mod.scaffold("ingress"))
+    assert ing["ingress"]["workers"] == 16
+    qos = qos_from_conf(
+        config_mod._parse_toml_subset(config_mod.scaffold("qos")))
+    assert qos is not None and "gold" in qos.classes

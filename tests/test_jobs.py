@@ -269,6 +269,49 @@ def test_policy_skips_volumes_with_active_jobs():
     assert pe.evaluate([_row(50.0, replicas=1)]) == []
 
 
+def test_policy_cache_warmth_blocks_seal_and_shrink():
+    """PR 10 satellite: a warm volume's read rate is mostly cache
+    hits, so the policy must not seal or shrink it on the strength of
+    a low DISK rate — churned caches would dump the load right back."""
+    clock = FakeClock()
+    pe = _policy(clock)
+    pe.configure({"warm_cache_hit_ratio": 0.5})
+    # cold-and-full normally seals to EC; warm cache vetoes it
+    assert pe.evaluate(
+        [_row(0.0, read_only=True, cache_warmth=0.9)]) == []
+    # cold-and-overreplicated normally shrinks; warm cache vetoes it
+    clock.t += 61.0
+    assert pe.evaluate(
+        [_row(0.2, replicas=2, cache_warmth=0.9)]) == []
+    # below the warmth threshold both proceed as before
+    clock.t += 61.0
+    acts = pe.evaluate([_row(0.2, replicas=2, cache_warmth=0.3)])
+    assert [a["action"] for a in acts] == ["replica_drop"]
+    assert acts[0]["cacheWarmth"] == 0.3
+
+
+def test_policy_cache_warmth_lowers_replicate_threshold():
+    clock = FakeClock()
+    pe = _policy(clock)  # hot=10, cool=1
+    pe.configure({"warm_cache_hit_ratio": 0.5})
+    # mid-band rate (cool <= 5 < hot) grows nothing when cold...
+    assert pe.evaluate([_row(5.0, replicas=1, cache_warmth=0.0)]) == []
+    # ...but a warm volume at the same rate replicates early: its
+    # cache-absorbed demand is real demand
+    acts = pe.evaluate([_row(5.0, replicas=1, cache_warmth=0.9)])
+    assert [a["action"] for a in acts] == ["replicate"]
+    # warmth still respects max_replicas
+    clock.t += 61.0
+    assert pe.evaluate(
+        [_row(5.0, replicas=3, cache_warmth=0.9)]) == []
+
+
+def test_policy_payload_reports_warmth_threshold():
+    pe = _policy(FakeClock())
+    pe.configure({"warm_cache_hit_ratio": 0.42})
+    assert pe.payload()["thresholds"]["warm_cache_hit_ratio"] == 0.42
+
+
 def test_policy_rejects_inverted_hysteresis_band():
     with pytest.raises(ValueError):
         PolicyEngine().configure({"hot_read_ops_per_second": 1.0,

@@ -261,6 +261,29 @@ def test_registry_counter_regression_is_a_restart():
     assert 0.0 <= row["read_ops_per_second"] < before
 
 
+def test_registry_volume_cache_warmth_aggregates_nodes():
+    """PR 10 satellite: cluster-wide hit ratio per volume, summed
+    across the nodes serving it (feeds the jobs policy rows)."""
+    now = [1000.0]
+    reg = telemetry.ClusterTelemetry(clock=lambda: now[0])
+    s1 = master_pb2.TelemetrySnapshot(window_ns=1_000_000_000)
+    s1.volumes.add(volume_id=1, cache_hits=90, cache_misses=10)
+    s1.volumes.add(volume_id=2, cache_hits=0, cache_misses=50)
+    s2 = master_pb2.TelemetrySnapshot(window_ns=1_000_000_000)
+    s2.volumes.add(volume_id=1, cache_hits=10, cache_misses=90)
+    reg.ingest("n1", s1)
+    reg.ingest("n2", s2)
+    w = reg.volume_cache_warmth()
+    # volume 1: (90+10) hits of (100+100) lookups across both nodes
+    assert w[1] == pytest.approx(0.5)
+    assert w[2] == pytest.approx(0.0)
+    # a volume with no lookups at all scores 0, not NaN
+    s3 = master_pb2.TelemetrySnapshot(window_ns=1_000_000_000)
+    s3.volumes.add(volume_id=3)
+    reg.ingest("n1", s3)
+    assert reg.volume_cache_warmth()[3] == 0.0
+
+
 def test_registry_windows_prune_and_forget():
     now = [0.0]
     reg = telemetry.ClusterTelemetry(halflife=10.0, window=30.0,

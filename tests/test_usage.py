@@ -256,14 +256,22 @@ def test_lookup_ranks_warm_healthy_replicas_first():
     assert [n["url"] for n in ms.lookup(1)] == \
         ["h1:8080", "h2:8080", "h3:8080"]
     tele = ms.topology.telemetry
-    # h1 errors hard -> degraded; h3 is warm for volume 1
+    # h1 errors hard -> unhealthy; h3 is warm for volume 1
     tele.ingest("h1:8080", _tele_snap(1, read_ops=100, errors=60))
     tele.ingest("h2:8080", _tele_snap(1, read_ops=100))
     tele.ingest("h3:8080", _tele_snap(1, read_ops=100,
                                       hits=95, misses=5))
     urls = [n["url"] for n in ms.lookup(1)]
-    assert urls[0] == "h3:8080"      # healthy + warm cache
-    assert urls[-1] == "h1:8080"     # error-heavy node demoted
+    # lookup-time shedding (PR 10): the condemned node is EXCLUDED
+    # while healthy replicas remain, warm-cache replica leads
+    assert urls == ["h3:8080", "h2:8080"]
+    assert ms.metrics.counter("lookup_unhealthy_excluded_total") \
+        .value >= 1
+    # the floor: with every replica condemned, all locations return
+    # (a slow answer beats none)
+    tele.ingest("h2:8080", _tele_snap(1, read_ops=100, errors=60))
+    tele.ingest("h3:8080", _tele_snap(1, read_ops=100, errors=60))
+    assert len(ms.lookup(1)) == 3
 
 
 def test_lookup_ec_fallback_reports_shards_ranked():
@@ -416,12 +424,16 @@ def test_usage_cluster_end_to_end(tmp_path):
         while time.time() < deadline:
             locs = _get_json(f"{base}/dir/lookup?volumeId={vid}")
             got = [n["url"] for n in locs["locations"]]
-            if got == [healthy, victim]:
+            # degraded -> demoted to the tail; unhealthy -> excluded
+            # outright (PR 10 lookup-time shedding). Which verdict the
+            # error burst lands on depends on EWMA decay timing, but
+            # either way the victim must stop leading.
+            if got in ([healthy, victim], [healthy]):
                 ranked = got
                 break
             time.sleep(0.1)
-        assert ranked == [healthy, victim], \
-            f"faulted replica {victim} was not demoted"
+        assert ranked is not None, \
+            f"faulted replica {victim} was neither demoted nor shed"
     finally:
         faults.clear()
         usage.configure(push_interval_seconds=usage.PUSH_INTERVAL)
