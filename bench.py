@@ -65,6 +65,7 @@ PROFILE_TIMEOUT = 300    # profiler-overhead stage (CPU mini cluster)
 USAGE_TIMEOUT = 300      # usage-accounting-overhead stage (CPU mini cluster)
 JOBS_TIMEOUT = 300       # maintenance-plane-overhead stage (CPU mini cluster)
 INGRESS_TIMEOUT = 300    # ingress-admission-overhead stage (CPU mini cluster)
+SIM_TIMEOUT = 300        # cluster-at-scale sim stage (in-process master)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -261,6 +262,13 @@ def parent() -> None:
     rc, out = _run(["--child-ingress-overhead"], _scrubbed_env(),
                    INGRESS_TIMEOUT)
     stage_platforms["ingress"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Cluster-at-scale master ceilings from the simulation harness
+    # (docs/simulation.md) — CPU-only by design: it measures the
+    # master's control plane, not the chip.
+    rc, out = _run(["--child-sim"], _scrubbed_env(), SIM_TIMEOUT)
+    stage_platforms["sim"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     merged = _read_partials()
@@ -1957,6 +1965,55 @@ def child_ingress_overhead() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_sim() -> None:
+    """Master ceilings at simulated cluster scale (docs/simulation.md).
+
+    300 simulated volume servers / 30k volumes drive one real
+    in-process MasterServer through a zipfian traffic-shift wave and a
+    rack-loss wave on a virtual clock, then measure the ingestion hot
+    paths wall-clock: steady-state heartbeat sweeps (the
+    unchanged-topology fast path), a full policy tick (the O(volumes)
+    ``cluster_rows`` fold), and ranked ``/dir/lookup`` latency.
+    Invariant failures fail the stage — these numbers are only worth
+    persisting for a cluster that actually converged."""
+    import logging
+
+    from seaweedfs_tpu.sim import SimCluster, run_scenario
+
+    # after the import: glog installs its handler at import time and
+    # would override a level set before it
+    logging.getLogger("seaweedfs_tpu").setLevel(logging.ERROR)
+
+    cluster = SimCluster(nodes=300, volumes=30_000, seed=7)
+    report = run_scenario(cluster, [
+        {"wave": "traffic_shift", "hot_ticks": 8, "cool_ticks": 14,
+         "ops": 4000},
+        {"wave": "rack_loss", "outage_ticks": 5, "recovery_ticks": 6},
+    ], log=log)
+    if not report["ok"]:
+        raise SystemExit(f"sim stage: invariant failures: "
+                         f"{[w['problems'] for w in report['waves']]}")
+    b = report["bench"]
+    res = {
+        "sim_nodes": report["nodes"],
+        "sim_volumes": report["volumes"],
+        "sim_heartbeats_per_second": b["heartbeats_per_second"],
+        "sim_policy_tick_seconds": b["policy_tick_seconds"],
+        "sim_lookup_p99_seconds": b["lookup_p99_seconds"],
+        "sim_lookup_p50_seconds": b["lookup_p50_seconds"],
+        "sim_unchanged_heartbeat_fraction": round(
+            report["heartbeats_unchanged"]
+            / max(1, report["heartbeats_total"]), 4),
+        "sim_waves_ok": True,
+    }
+    log(f"sim stage: {res['sim_heartbeats_per_second']:.0f} hb/s, "
+        f"policy tick {res['sim_policy_tick_seconds'] * 1e3:.1f}ms, "
+        f"lookup p99 {res['sim_lookup_p99_seconds'] * 1e6:.0f}us at "
+        f"{res['sim_nodes']} nodes / {res['sim_volumes']} volumes")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -1994,5 +2051,7 @@ if __name__ == "__main__":
     elif ("--child-ingress-overhead" in sys.argv
           or "--ingress-overhead" in sys.argv):
         child_ingress_overhead()
+    elif "--child-sim" in sys.argv:
+        child_sim()
     else:
         parent()

@@ -87,5 +87,19 @@ if [ "$rc" -ne 0 ]; then
     echo "lint_gate: ingress_smoke failed (exit $rc) — admission" \
          "control or per-tenant QoS regressed; see" \
          "scripts/ingress_smoke.sh" >&2
+    exit "$rc"
+fi
+
+# Simulation smoke (docs/simulation.md): 200 simulated volume servers
+# drive one real master through a traffic-shift and a rack-loss wave
+# on a virtual clock; every convergence invariant must hold and the
+# master-ceiling bench numbers must be present.
+bash scripts/sim_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: sim_smoke failed (exit $rc) — a policy/topology" \
+         "convergence invariant broke at simulated scale; see" \
+         "scripts/sim_smoke.sh" >&2
 fi
 exit "$rc"

@@ -148,7 +148,8 @@ class _Job:
         self.created = created
         self.tasks: list[_Task] = []
 
-    def to_map(self, with_tasks: bool = True) -> dict:
+    def to_map(self, with_tasks: bool = True,
+               limit: Optional[int] = None) -> dict:
         counts: dict[str, int] = {}
         for t in self.tasks:
             counts[t.state] = counts.get(t.state, 0) + 1
@@ -158,7 +159,18 @@ class _Job:
                "created": self.created, "taskCounts": counts,
                "total": len(self.tasks)}
         if with_tasks:
-            out["tasks"] = [t.to_map() for t in self.tasks]
+            tasks = self.tasks
+            if limit is not None and 0 < limit < len(tasks):
+                # Non-terminal tasks first: a truncated view of a
+                # million-task sweep should show the live work, and
+                # ``tasksOmitted`` says how much was cut.
+                live = [t for t in tasks if t.state in
+                        ("pending", "leased")]
+                rest = [t for t in tasks if t.state not in
+                        ("pending", "leased")]
+                tasks = (live + rest)[:limit]
+                out["tasksOmitted"] = len(self.tasks) - len(tasks)
+            out["tasks"] = [t.to_map() for t in tasks]
         return out
 
 
@@ -482,9 +494,10 @@ class JobManager:
                     if j.state in ("active", "paused")
                     for t in j.tasks if t.state not in _TERMINAL}
 
-    def to_map(self, with_tasks: bool = True) -> dict:
+    def to_map(self, with_tasks: bool = True,
+               limit: Optional[int] = None) -> dict:
         with self._lock:
-            jobs = [self._jobs[jid].to_map(with_tasks)
+            jobs = [self._jobs[jid].to_map(with_tasks, limit=limit)
                     for jid in self._order]
             return {"enabled": _ENABLED,
                     "leaseSeconds": self.lease_seconds,

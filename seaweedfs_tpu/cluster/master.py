@@ -69,13 +69,18 @@ class MasterServer:
                  election_timeout: tuple[float, float] = (0.45, 0.9),
                  metrics_address: str = "",
                  metrics_interval_seconds: float = 15.0,
-                 trace_ring_size: int = 256):
+                 trace_ring_size: int = 256,
+                 clock=time.time):
         self.ip = ip
         self.port = port
         self.url = f"{ip}:{port}"
+        #: Injectable time source threaded through every registry so
+        #: the sim harness can drive the whole control plane on a
+        #: virtual clock (seaweedfs_tpu/sim); production uses time.time.
+        self.clock = clock
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
-            pulse_seconds=pulse_seconds, seed=seed)
+            pulse_seconds=pulse_seconds, seed=seed, clock=clock)
         if sequencer is None and meta_dir:
             Path(meta_dir).mkdir(parents=True, exist_ok=True)
             sequencer = MemorySequencer(
@@ -120,12 +125,12 @@ class MasterServer:
         #: /cluster/* read paths leader-proxy like /cluster/telemetry.
         self.trace_collector = tracing.TraceCollector(
             ring_size=trace_ring_size)
-        self.slo = SloEngine(self.topology.telemetry)
+        self.slo = SloEngine(self.topology.telemetry, clock=clock)
         #: Traffic accounting registry: volume servers ride the
         #: heartbeat (Heartbeat.usage); gateways/filer POST the same
         #: payload to /cluster/usage. Leader-only for the same reason
         #: as traces/telemetry.
-        self.usage = usage_mod.ClusterUsage()
+        self.usage = usage_mod.ClusterUsage(clock=clock)
         #: Maintenance plane (docs/jobs.md): durable per-volume task
         #: queues pulled by volume servers under leases renewed on the
         #: heartbeat, plus the policy engine that turns telemetry/usage
@@ -136,8 +141,10 @@ class MasterServer:
             topology=self.topology,
             checkpoint_path=(Path(meta_dir) / "jobs.json")
             if meta_dir else None,
+            clock=clock,
             on_commit=self._job_task_committed)
-        self.policy = jobs_mod.PolicyEngine(master=self, jobs=self.jobs)
+        self.policy = jobs_mod.PolicyEngine(master=self, jobs=self.jobs,
+                                            clock=clock)
         #: Cluster cache-invalidation fan-out: gateways subscribe via
         #: POST /cluster/cache_subscribe; job commits that mutate a
         #: volume's bytes publish to subscribers + all volume servers.
@@ -580,8 +587,8 @@ class MasterServer:
         ranked = []
         for i, n in enumerate(nodes):
             h = tele.health(n.url, n.last_seen, pulse)
-            warmth = tele.node_volumes(n.url).get(
-                volume_id, {}).get("cache_hit_ratio", 0.0)
+            warmth = tele.volume_row(n.url, volume_id).get(
+                "cache_hit_ratio", 0.0)
             key = (tiers.get(h["verdict"], 2),
                    -(h["score"] + 25.0 * warmth), i)
             ranked.append((key, n))
@@ -594,6 +601,61 @@ class MasterServer:
             ranked = ranked[:alive]  # sort left unhealthy at the tail
         return [n for _key, n in ranked]
 
+    # ------------- heartbeat ingestion -------------
+
+    def ingest_heartbeat(self, hb) -> master_pb2.HeartbeatResponse:
+        """One heartbeat through the full ingestion path — shared by
+        the gRPC stream servicer and the sim harness (which drives a
+        real master in-process, no sockets).
+
+        The steady-state fast path: a pulse whose snapshot changes
+        nothing in the topology allocates no span and formats no log
+        line — at thousands of nodes the per-pulse cost must stay flat
+        (the sim's span-count test pins this down), and unchanged
+        pulses are the overwhelmingly common case.
+        """
+        url = f"{hb.ip}:{hb.port}"
+        volumes = [VolumeInfo(
+            id=v.id, collection=v.collection, size=v.size,
+            file_count=v.file_count, delete_count=v.delete_count,
+            deleted_byte_count=v.deleted_byte_count,
+            read_only=v.read_only,
+            replica_placement=str(
+                ReplicaPlacement.from_byte(v.replica_placement)),
+            version=v.version or 3,
+            ttl="" if not v.ttl else str(Ttl.from_bytes(
+                v.ttl.to_bytes(2, "big"))),
+            modified_at_second=v.modified_at_second,
+        ) for v in hb.volumes]
+        ec = [(s.collection, s.id, s.ec_index_bits)
+              for s in hb.ec_shards]
+        node = self.topology.register_heartbeat(
+            url, public_url=hb.public_url,
+            data_center=hb.data_center, rack=hb.rack,
+            max_volume_count=hb.max_volume_count or 8,
+            volumes=volumes, ec_shards=ec)
+        if node.last_heartbeat_changed:
+            with tracing.span("master.heartbeat.topology", node=url,
+                              volumes=str(len(volumes))):
+                glog.v(1, "master: heartbeat from %s changed topology "
+                       "(%d volumes, %d ec entries)", url,
+                       len(volumes), len(ec))
+        if hb.HasField("telemetry"):
+            self.topology.telemetry.ingest(url, hb.telemetry,
+                                           metrics=self.metrics)
+        if hb.HasField("usage"):
+            self.usage.ingest_proto(url, hb.usage)
+        if hb.HasField("job_progress"):
+            # The heartbeat IS the lease renewal for every task
+            # the worker still reports in flight.
+            self.jobs.renew(url, hb.job_progress)
+        if hb.max_file_key:
+            self.sequencer.set_max(hb.max_file_key)
+        return master_pb2.HeartbeatResponse(
+            volume_size_limit=self.topology.volume_size_limit,
+            leader=self.leader_url or self.url,
+            metrics_address=self.metrics_address)
+
 
 class _MasterServicer:
     """gRPC service impl bound via pb.generic_handler."""
@@ -602,43 +664,8 @@ class _MasterServicer:
         self.ms = ms
 
     def SendHeartbeat(self, request_iterator, context):
-        ms = self.ms
         for hb in request_iterator:
-            url = f"{hb.ip}:{hb.port}"
-            volumes = [VolumeInfo(
-                id=v.id, collection=v.collection, size=v.size,
-                file_count=v.file_count, delete_count=v.delete_count,
-                deleted_byte_count=v.deleted_byte_count,
-                read_only=v.read_only,
-                replica_placement=str(
-                    ReplicaPlacement.from_byte(v.replica_placement)),
-                version=v.version or 3,
-                ttl="" if not v.ttl else str(Ttl.from_bytes(
-                    v.ttl.to_bytes(2, "big"))),
-                modified_at_second=v.modified_at_second,
-            ) for v in hb.volumes]
-            ec = [(s.collection, s.id, s.ec_index_bits)
-                  for s in hb.ec_shards]
-            ms.topology.register_heartbeat(
-                url, public_url=hb.public_url,
-                data_center=hb.data_center, rack=hb.rack,
-                max_volume_count=hb.max_volume_count or 8,
-                volumes=volumes, ec_shards=ec)
-            if hb.HasField("telemetry"):
-                ms.topology.telemetry.ingest(url, hb.telemetry,
-                                             metrics=ms.metrics)
-            if hb.HasField("usage"):
-                ms.usage.ingest_proto(url, hb.usage)
-            if hb.HasField("job_progress"):
-                # The heartbeat IS the lease renewal for every task
-                # the worker still reports in flight.
-                ms.jobs.renew(url, hb.job_progress)
-            if hb.max_file_key:
-                ms.sequencer.set_max(hb.max_file_key)
-            yield master_pb2.HeartbeatResponse(
-                volume_size_limit=ms.topology.volume_size_limit,
-                leader=ms.leader_url or ms.url,
-                metrics_address=ms.metrics_address)
+            yield self.ms.ingest_heartbeat(hb)
 
     def Assign(self, request, context):
         try:
@@ -860,9 +887,12 @@ def _make_http_handler(ms: MasterServer):
                         return
                     last_seen = {n.url: n.last_seen
                                  for n in ms.topology.snapshot_nodes()}
+                    # Default cap keeps the per-volume section top-N by
+                    # read rate; ?limit=0 restores the unbounded body.
                     self._json(ms.topology.telemetry.to_map(
                         nodes_last_seen=last_seen,
-                        pulse_seconds=ms.topology.pulse_seconds))
+                        pulse_seconds=ms.topology.pulse_seconds,
+                        limit=int(q.get("limit", 512)) or None))
                 elif u.path == "/cluster/traces":
                     # Tail-sampled traces land on the leader (that is
                     # where servers push), so read from there.
@@ -875,7 +905,8 @@ def _make_http_handler(ms: MasterServer):
                     # pushes go there), so read from there.
                     if self._proxy_to_leader():
                         return
-                    self._json(ms.usage.to_map())
+                    self._json(ms.usage.to_map(
+                        limit=int(q.get("limit", 256)) or None))
                 elif u.path == "/cluster/topk":
                     if self._proxy_to_leader():
                         return
@@ -887,7 +918,8 @@ def _make_http_handler(ms: MasterServer):
                     if self._proxy_to_leader():
                         return
                     doc = ms.jobs.to_map(
-                        with_tasks=q.get("tasks", "1") != "0")
+                        with_tasks=q.get("tasks", "1") != "0",
+                        limit=int(q.get("limit", 1000)) or None)
                     doc["policy"] = ms.policy.payload()
                     self._json(doc)
                 elif u.path == "/cluster/slo":

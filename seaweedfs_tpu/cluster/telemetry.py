@@ -227,7 +227,8 @@ class _VolAgg:
 
 
 class _NodeAgg:
-    __slots__ = ("volumes", "last_ingest", "snapshots", "hot_stacks")
+    __slots__ = ("volumes", "last_ingest", "snapshots", "hot_stacks",
+                 "last_gauges")
 
     def __init__(self):
         self.volumes: dict[int, _VolAgg] = {}
@@ -235,6 +236,15 @@ class _NodeAgg:
         self.snapshots = 0
         #: latest heartbeat's profiler top-k: [(collapsed_stack, n)]
         self.hot_stacks: list[tuple[str, int]] = []
+        #: last time this node's Prometheus gauges were refreshed —
+        #: gauge upkeep is rate-limited off the per-pulse hot path.
+        self.last_gauges = 0.0
+
+
+#: Per-node Prometheus series cap: only the top-K volumes by read rate
+#: keep per-volume gauges, so a thousand-volume node exports a bounded
+#: series set instead of one gauge pair per volume.
+VOLUME_GAUGE_CAP = 64
 
 
 class ClusterTelemetry:
@@ -248,12 +258,22 @@ class ClusterTelemetry:
 
     def __init__(self, halflife: float = DECAY_HALFLIFE,
                  window: float = DIGEST_WINDOW,
-                 clock=time.time):
+                 clock=time.time,
+                 gauge_interval: float = 15.0):
         self._lock = threading.Lock()
         self._nodes: dict[str, _NodeAgg] = {}
         self.halflife = max(1.0, float(halflife))
         self.window = max(1.0, float(window))
         self.clock = clock
+        #: Minimum seconds between per-node gauge refreshes (the first
+        #: ingest for a node always updates, so tests and fresh nodes
+        #: see series immediately).
+        self.gauge_interval = max(0.0, float(gauge_interval))
+        #: Data generation + memo for the cluster median p99 — the
+        #: lookup ranking path asks for it per replica set, and without
+        #: the memo each ask walks every node's digest windows.
+        self._gen = 0
+        self._median_cache: tuple[int, Optional[float]] = (-1, None)
 
     # ---------------- ingestion ----------------
 
@@ -262,6 +282,7 @@ class ClusterTelemetry:
                metrics: Optional[Metrics] = None) -> None:
         now = self.clock()
         with self._lock:
+            self._gen += 1
             node = self._nodes.get(node_url)
             if node is None:
                 node = self._nodes[node_url] = _NodeAgg()
@@ -275,11 +296,13 @@ class ClusterTelemetry:
                 node.hot_stacks = [(hs.stack, int(hs.samples))
                                    for hs in snap.hot_stacks]
             seen = set()
+            new_volume = False
             for v in snap.volumes:
                 seen.add(v.volume_id)
                 agg = node.volumes.get(v.volume_id)
                 if agg is None:
                     agg = node.volumes[v.volume_id] = _VolAgg()
+                    new_volume = True
                 if v.collection:
                     agg.collection = v.collection
                 for f in _RATE_FIELDS:
@@ -309,34 +332,57 @@ class ClusterTelemetry:
                         now - agg.windows[0][0] > self.window:
                     agg.windows.popleft()
         if metrics is not None:
-            self._update_gauges(metrics, node_url)
+            # A never-exported node or a volume the gauges have not
+            # seen yet refreshes immediately; steady state is
+            # rate-limited to one refresh per gauge_interval.
+            due = new_volume or node.last_gauges == 0.0 or \
+                now - node.last_gauges >= self.gauge_interval
+            if due:
+                node.last_gauges = now
+                self._update_gauges(metrics, node_url)
 
     def forget(self, node_url: str) -> None:
         """Drop a node (reaped from the topology)."""
         with self._lock:
+            self._gen += 1
             self._nodes.pop(node_url, None)
 
     def _update_gauges(self, metrics: Metrics, node_url: str) -> None:
         """Master-side Prometheus gauges for the node just ingested.
 
-        Cardinality is bounded by live (node, volume) pairs — the same
-        bound the topology itself carries.
+        Reads the raw aggregates directly (no per-volume row rendering
+        or digest merging) and keeps per-volume series for only the
+        top ``VOLUME_GAUGE_CAP`` volumes by read rate, so a node with
+        hundreds of volumes costs a bounded, flat amount per refresh.
         """
-        view = self.node_volumes(node_url)
+        now = self.clock()
+        rows: list[tuple[float, int, float]] = []
         tot_read = tot_write = 0.0
-        for vid, row in view.items():
-            tot_read += row["read_ops_per_second"]
-            tot_write += row["write_ops_per_second"]
+        with self._lock:
+            node = self._nodes.get(node_url)
+            if node is None:
+                return
+            decay = self._decay_factor(node, now)
+            for vid, agg in node.volumes.items():
+                r = agg.rates["read_ops"] * decay
+                tot_read += r
+                tot_write += agg.rates["write_ops"] * decay
+                hits = agg.cum["cache_hits"]
+                looked = hits + agg.cum["cache_misses"]
+                rows.append((r, vid,
+                             hits / looked if looked else 0.0))
+        if len(rows) > VOLUME_GAUGE_CAP:
+            rows.sort(key=lambda t: -t[0])
+            del rows[VOLUME_GAUGE_CAP:]
+        for r, vid, ratio in rows:
             metrics.gauge(
                 "telemetry_volume_read_ops_per_second",
-                # seaweedlint: disable=SW401 — bounded by live volumes
-                node=node_url, volume=str(vid)).set(
-                    row["read_ops_per_second"])
+                # seaweedlint: disable=SW401 — VOLUME_GAUGE_CAP cap
+                node=node_url, volume=str(vid)).set(r)
             metrics.gauge(
                 "telemetry_volume_cache_hit_ratio",
-                # seaweedlint: disable=SW401 — bounded by live volumes
-                node=node_url, volume=str(vid)).set(
-                    row["cache_hit_ratio"])
+                # seaweedlint: disable=SW401 — VOLUME_GAUGE_CAP cap
+                node=node_url, volume=str(vid)).set(ratio)
         metrics.gauge("telemetry_node_read_ops_per_second",
                       node=node_url).set(tot_read)
         metrics.gauge("telemetry_node_write_ops_per_second",
@@ -361,39 +407,60 @@ class ClusterTelemetry:
             if node is None:
                 return {}
             decay = self._decay_factor(node, now)
-            out = {}
-            for vid, agg in node.volumes.items():
-                hits = agg.cum["cache_hits"]
-                misses = agg.cum["cache_misses"]
-                looked = hits + misses
-                row = {
-                    "collection": agg.collection,
-                    "read_ops": agg.cum["read_ops"],
-                    "write_ops": agg.cum["write_ops"],
-                    "read_bytes": agg.cum["read_bytes"],
-                    "write_bytes": agg.cum["write_bytes"],
-                    "cache_hits": hits, "cache_misses": misses,
-                    "cache_hit_ratio":
-                        hits / looked if looked else 0.0,
-                    "ec_decodes": agg.cum["ec_decodes"],
-                    "errors": agg.cum["errors"],
-                    "read_ops_per_second":
-                        agg.rates["read_ops"] * decay,
-                    "write_ops_per_second":
-                        agg.rates["write_ops"] * decay,
-                    "read_bytes_per_second":
-                        agg.rates["read_bytes"] * decay,
-                    "errors_per_second":
-                        agg.rates["errors"] * decay,
-                }
-                d = self._merged_locked(node, vid, read=True)
-                if d is not None and d.count:
-                    row["read_latency"] = _digest_summary(d)
-                d = self._merged_locked(node, vid, read=False)
-                if d is not None and d.count:
-                    row["write_latency"] = _digest_summary(d)
-                out[vid] = row
-            return out
+            return {vid: self._row_locked(node, vid, agg, decay)
+                    for vid, agg in node.volumes.items()}
+
+    def _row_locked(self, node: _NodeAgg, vid: int, agg: _VolAgg,
+                    decay: float) -> dict:
+        hits = agg.cum["cache_hits"]
+        misses = agg.cum["cache_misses"]
+        looked = hits + misses
+        row = {
+            "collection": agg.collection,
+            "read_ops": agg.cum["read_ops"],
+            "write_ops": agg.cum["write_ops"],
+            "read_bytes": agg.cum["read_bytes"],
+            "write_bytes": agg.cum["write_bytes"],
+            "cache_hits": hits, "cache_misses": misses,
+            "cache_hit_ratio":
+                hits / looked if looked else 0.0,
+            "ec_decodes": agg.cum["ec_decodes"],
+            "errors": agg.cum["errors"],
+            "read_ops_per_second":
+                agg.rates["read_ops"] * decay,
+            "write_ops_per_second":
+                agg.rates["write_ops"] * decay,
+            "read_bytes_per_second":
+                agg.rates["read_bytes"] * decay,
+            "errors_per_second":
+                agg.rates["errors"] * decay,
+        }
+        d = self._merged_locked(node, vid, read=True)
+        if d is not None and d.count:
+            row["read_latency"] = _digest_summary(d)
+        d = self._merged_locked(node, vid, read=False)
+        if d is not None and d.count:
+            row["write_latency"] = _digest_summary(d)
+        return row
+
+    def volume_row(self, node_url: str, vid: int) -> dict:
+        """The two signals `/dir/lookup` ranking needs for one volume
+        on one node — O(1), no digest merges, no full-node render
+        (``node_volumes`` builds every row on the node, which at
+        hundreds of volumes per node is far too heavy per lookup)."""
+        now = self.clock()
+        with self._lock:
+            node = self._nodes.get(node_url)
+            agg = node.volumes.get(vid) if node is not None else None
+            if agg is None:
+                return {}
+            hits = agg.cum["cache_hits"]
+            looked = hits + agg.cum["cache_misses"]
+            return {
+                "cache_hit_ratio": hits / looked if looked else 0.0,
+                "read_ops_per_second":
+                    agg.rates["read_ops"] * self._decay_factor(node, now),
+            }
 
     def _merged_locked(self, node: _NodeAgg, vid: Optional[int],
                        read: bool = True) -> Optional[Digest]:
@@ -501,16 +568,30 @@ class ClusterTelemetry:
                     if node.hot_stacks}
 
     def cluster_median_p99(self, read: bool = True) -> Optional[float]:
+        # Memoized per data generation (read side only — that is the
+        # one health() asks for on every ranked lookup): recomputing
+        # walks every node's digest windows, and between ingests the
+        # answer cannot change.
+        if read:
+            with self._lock:
+                gen = self._gen
+                cached_gen, cached = self._median_cache
+                if cached_gen == gen:
+                    return cached
         with self._lock:
             urls = list(self._nodes)
         p99s = sorted(p for p in (self.node_quantile(u, 0.99, read)
                                   for u in urls) if p is not None)
         if not p99s:
-            return None
-        mid = len(p99s) // 2
-        if len(p99s) % 2:
-            return p99s[mid]
-        return (p99s[mid - 1] + p99s[mid]) / 2.0
+            median = None
+        else:
+            mid = len(p99s) // 2
+            median = p99s[mid] if len(p99s) % 2 else \
+                (p99s[mid - 1] + p99s[mid]) / 2.0
+        if read:
+            with self._lock:
+                self._median_cache = (gen, median)
+        return median
 
     # ---------------- health ----------------
 
@@ -569,12 +650,21 @@ class ClusterTelemetry:
     # ---------------- the /cluster/telemetry payload ----------------
 
     def to_map(self, nodes_last_seen: Optional[dict] = None,
-               pulse_seconds: float = 5.0) -> dict:
+               pulse_seconds: float = 5.0,
+               limit: Optional[int] = None) -> dict:
         """JSON body for ``/cluster/telemetry``. ``nodes_last_seen``
-        maps node url -> topology ``last_seen`` (health needs it)."""
+        maps node url -> topology ``last_seen`` (health needs it).
+
+        ``limit`` caps the per-volume section to the top-N volumes by
+        cluster-wide read rate (``volumes_total``/``volumes_omitted``
+        say what was dropped) — without it a million-volume cluster
+        renders a multi-MB document."""
         nodes_last_seen = nodes_last_seen or {}
         with self._lock:
             urls = sorted(set(self._nodes) | set(nodes_last_seen))
+        if limit is not None and int(limit) > 0:
+            return self._to_map_capped(urls, nodes_last_seen,
+                                       pulse_seconds, int(limit))
         nodes = {}
         volumes: dict[str, dict] = {}
         for url in urls:
@@ -605,6 +695,79 @@ class ClusterTelemetry:
                     url, nodes_last_seen[url], pulse_seconds)
             nodes[url] = entry
         out = {"nodes": nodes, "volumes": volumes,
+               "decay_halflife_seconds": self.halflife,
+               "digest_window_seconds": self.window}
+        median = self.cluster_median_p99()
+        if median is not None:
+            out["cluster_median_read_p99_seconds"] = median
+        return out
+
+    def _to_map_capped(self, urls: list, nodes_last_seen: dict,
+                       pulse_seconds: float, limit: int) -> dict:
+        """The ``limit``-capped `/cluster/telemetry` body: node totals
+        are computed from the raw aggregates (no per-volume row render)
+        and full rows are built only for the top-``limit`` volumes."""
+        now = self.clock()
+        nodes = {}
+        per_vid_rate: dict[int, float] = {}
+        vid_holders: dict[int, list[str]] = {}
+        for url in urls:
+            with self._lock:
+                node = self._nodes.get(url)
+                snapshots = node.snapshots if node else 0
+                last_ingest = node.last_ingest if node else 0.0
+                hot = list(node.hot_stacks) if node else []
+                totals = {"read_ops_per_second": 0.0,
+                          "write_ops_per_second": 0.0,
+                          "errors_per_second": 0.0}
+                nvols = 0
+                if node is not None:
+                    decay = self._decay_factor(node, now)
+                    nvols = len(node.volumes)
+                    for vid, agg in node.volumes.items():
+                        r = agg.rates["read_ops"] * decay
+                        totals["read_ops_per_second"] += r
+                        totals["write_ops_per_second"] += \
+                            agg.rates["write_ops"] * decay
+                        totals["errors_per_second"] += \
+                            agg.rates["errors"] * decay
+                        per_vid_rate[vid] = \
+                            per_vid_rate.get(vid, 0.0) + r
+                        vid_holders.setdefault(vid, []).append(url)
+            entry = {"snapshots": snapshots,
+                     "last_ingest": last_ingest,
+                     "volume_count": nvols, **totals}
+            p99 = self.node_quantile(url, 0.99)
+            if p99 is not None:
+                entry["read_p99_seconds"] = p99
+            if hot:
+                entry["hot_stacks"] = [{"stack": s, "samples": n}
+                                       for s, n in hot]
+            if url in nodes_last_seen:
+                entry["health"] = self.health(
+                    url, nodes_last_seen[url], pulse_seconds)
+            nodes[url] = entry
+        top = sorted(per_vid_rate,
+                     key=lambda v: (-per_vid_rate[v], v))[:limit]
+        volumes: dict[str, dict] = {}
+        for vid in top:
+            by_node = {}
+            for url in vid_holders.get(vid, ()):
+                with self._lock:
+                    node = self._nodes.get(url)
+                    agg = node.volumes.get(vid) \
+                        if node is not None else None
+                    if agg is None:
+                        continue
+                    by_node[url] = self._row_locked(
+                        node, vid, agg, self._decay_factor(node, now))
+            if by_node:
+                volumes[str(vid)] = by_node
+        out = {"nodes": nodes, "volumes": volumes,
+               "volumes_total": len(per_vid_rate),
+               "volumes_omitted":
+                   max(0, len(per_vid_rate) - len(top)),
+               "limit": limit,
                "decay_halflife_seconds": self.halflife,
                "digest_window_seconds": self.window}
         median = self.cluster_median_p99()

@@ -24,9 +24,14 @@ from ..storage.superblock import ReplicaPlacement, Ttl
 from .telemetry import ClusterTelemetry
 
 
-@dataclass
+@dataclass(slots=True)
 class VolumeInfo:
-    """One volume replica as reported by a heartbeat."""
+    """One volume replica as reported by a heartbeat.
+
+    ``slots=True`` matters at simulation scale: a million replicas are
+    resident in one master process, and the per-instance ``__dict__``
+    would triple their footprint.
+    """
     id: int
     collection: str = ""
     size: int = 0
@@ -51,6 +56,10 @@ class DataNode:
     volumes: dict[tuple[str, int], VolumeInfo] = field(default_factory=dict)
     ec_shards: dict[tuple[str, int], ShardBits] = field(default_factory=dict)
     last_seen: float = field(default_factory=time.time)
+    #: Did the last heartbeat snapshot change this node's contribution
+    #: to the indexes? Steady-state pulses leave it False, which is the
+    #: signal the ingestion path uses to skip span/log allocation.
+    last_heartbeat_changed: bool = True
 
     @property
     def volume_count(self) -> int:
@@ -100,53 +109,149 @@ class Topology:
     """The whole tree + layouts + EC shard map. Thread-safe."""
 
     def __init__(self, volume_size_limit: int = 30 * 1024 ** 3,
-                 pulse_seconds: float = 5.0, seed: Optional[int] = None):
+                 pulse_seconds: float = 5.0, seed: Optional[int] = None,
+                 clock=time.time):
         self._lock = threading.RLock()
         self.nodes: dict[str, DataNode] = {}
         self.layouts: dict[LayoutKey, VolumeLayout] = {}
         # vid -> {shard_id -> set of node urls}; collection in ec_collections
         self.ec_locations: dict[int, dict[int, set[str]]] = {}
         self.ec_collections: dict[int, str] = {}
+        # Reverse maps that make index maintenance per-volume instead of
+        # per-cluster: which nodes hold a (collection, vid), which layout
+        # keys it currently appears under, and which (url, collection)
+        # pairs hold EC shards for a vid. Kept in lockstep with
+        # ``layouts``/``ec_locations`` by ``_reindex_volume``/``_reindex_ec``.
+        self._vol_holders: dict[tuple[str, int], set[str]] = {}
+        self._vol_keys: dict[tuple[str, int], set[LayoutKey]] = {}
+        self._ec_holders: dict[int, dict[tuple[str, str], ShardBits]] = {}
         self.volume_size_limit = volume_size_limit
         self.pulse_seconds = pulse_seconds
         self.max_volume_id = 0
+        self.clock = clock
+        #: Ingestion counters for the sim/bench plane: total heartbeats
+        #: and how many took the unchanged-topology fast path.
+        self.heartbeats_total = 0
+        self.heartbeats_unchanged = 0
         self._rng = random.Random(seed)
         #: Rolling per-node/per-volume hot-stats registry fed by the
         #: telemetry snapshots riding heartbeats (telemetry.py).
-        self.telemetry = ClusterTelemetry()
+        self.telemetry = ClusterTelemetry(clock=clock)
 
     # ---------------- heartbeat ingestion ----------------
 
     def register_heartbeat(self, url: str, *, public_url: str = "",
                            data_center: str = "", rack: str = "",
                            max_volume_count: int = 8,
-                           volumes: Iterable[VolumeInfo] = (),
+                           volumes: "Iterable[VolumeInfo] | dict" = (),
                            ec_shards: Iterable[tuple[str, int, int]] = (),
                            ) -> DataNode:
         """Full-snapshot update of one node (SURVEY.md §3.4).
 
         ``ec_shards`` items are (collection, volume_id, ec_index_bits).
+
+        Index maintenance is per-node delta, not per-cluster rebuild:
+        only volumes whose index-relevant fields (membership, size,
+        read_only, placement, ttl) differ from the node's previous
+        snapshot are re-indexed, so a steady-state pulse costs O(node
+        volumes) to diff and touches no shared index entry at all.
+        ``node.last_heartbeat_changed`` records whether this snapshot
+        changed anything.
+
+        ``VolumeInfo`` objects are treated as immutable once reported:
+        a snapshot that reuses a previously-reported object is taken as
+        "no change" without field comparison, so callers must replace
+        (not mutate) an object to report new stats for its volume.
+
+        ``volumes`` may also be a pre-keyed ``{(collection, id):
+        VolumeInfo}`` dict, which is ADOPTED as the node's snapshot
+        without re-keying — ownership transfers, the caller must never
+        mutate it afterwards. The sim harness hands over ``dict(...)``
+        copies this way; at thousands of nodes the per-pulse tuple
+        construction is the difference between flat and quadratic.
         """
         with self._lock:
+            self.heartbeats_total += 1
             node = self.nodes.get(url)
+            changed = False
             if node is None:
                 node = DataNode(url=url)
                 self.nodes[url] = node
+                changed = True
             node.public_url = public_url or url
-            if data_center:
+            if data_center and node.data_center != data_center:
                 node.data_center = data_center
-            if rack:
+                changed = True
+            if rack and node.rack != rack:
                 node.rack = rack
-            node.max_volume_count = max_volume_count
-            node.last_seen = time.time()
-            node.volumes = {(v.collection, v.id): v for v in volumes}
-            node.ec_shards = {(c, vid): ShardBits(bits)
-                              for (c, vid, bits) in ec_shards}
-            for v in node.volumes.values():
-                self.max_volume_id = max(self.max_volume_id, v.id)
-            for (_c, vid) in node.ec_shards:
-                self.max_volume_id = max(self.max_volume_id, vid)
-            self._rebuild_indexes()
+                changed = True
+            if node.max_volume_count != max_volume_count:
+                node.max_volume_count = max_volume_count
+                changed = True
+            node.last_seen = self.clock()
+
+            old_vols = node.volumes
+            new_vols = volumes if isinstance(volumes, dict) \
+                else {(v.collection, v.id): v for v in volumes}
+            touched: list[tuple[str, int]] = []
+            for k, v in new_vols.items():
+                ov = old_vols.get(k)
+                if ov is v:
+                    continue
+                if ov is None or (ov.size != v.size
+                                  or ov.read_only != v.read_only
+                                  or ov.replica_placement
+                                  != v.replica_placement
+                                  or ov.ttl != v.ttl):
+                    touched.append(k)
+            removed = [k for k in old_vols if k not in new_vols]
+            node.volumes = new_vols
+            for k in removed:
+                hs = self._vol_holders.get(k)
+                if hs is not None:
+                    hs.discard(url)
+                    if not hs:
+                        del self._vol_holders[k]
+            for k in touched:
+                self._vol_holders.setdefault(k, set()).add(url)
+                if k[1] > self.max_volume_id:
+                    self.max_volume_id = k[1]
+            for k in touched:
+                self._reindex_volume(*k)
+            for k in removed:
+                self._reindex_volume(*k)
+
+            old_ec = node.ec_shards
+            new_ec = {(c, vid): ShardBits(bits)
+                      for (c, vid, bits) in ec_shards}
+            ec_touched: list[tuple[str, int]] = []
+            for k, bits in new_ec.items():
+                ob = old_ec.get(k)
+                if ob is None or ob.bits != bits.bits:
+                    ec_touched.append(k)
+            ec_removed = [k for k in old_ec if k not in new_ec]
+            node.ec_shards = new_ec
+            for (col, vid) in ec_removed:
+                hmap = self._ec_holders.get(vid)
+                if hmap is not None:
+                    hmap.pop((url, col), None)
+                    if not hmap:
+                        del self._ec_holders[vid]
+            for (col, vid) in ec_touched:
+                self._ec_holders.setdefault(vid, {})[(url, col)] = \
+                    new_ec[(col, vid)]
+                if vid > self.max_volume_id:
+                    self.max_volume_id = vid
+            for (_c, vid) in ec_touched:
+                self._reindex_ec(vid)
+            for (_c, vid) in ec_removed:
+                self._reindex_ec(vid)
+
+            changed = changed or bool(touched) or bool(removed) \
+                or bool(ec_touched) or bool(ec_removed)
+            node.last_heartbeat_changed = changed
+            if not changed:
+                self.heartbeats_unchanged += 1
             return node
 
     def register_volume(self, url: str, info: VolumeInfo) -> None:
@@ -157,9 +262,11 @@ class Topology:
             node = self.nodes.get(url)
             if node is None:
                 raise TopologyError(f"unknown data node {url}")
-            node.volumes[(info.collection, info.id)] = info
+            k = (info.collection, info.id)
+            node.volumes[k] = info
             self.max_volume_id = max(self.max_volume_id, info.id)
-            self._rebuild_indexes()
+            self._vol_holders.setdefault(k, set()).add(url)
+            self._reindex_volume(*k)
 
     def unregister_volume(self, url: str, volume_id: int,
                           collection: str = "") -> None:
@@ -169,8 +276,15 @@ class Topology:
             node = self.nodes.get(url)
             if node is None:
                 return
-            node.volumes.pop((collection, volume_id), None)
-            self._rebuild_indexes()
+            k = (collection, volume_id)
+            if node.volumes.pop(k, None) is None:
+                return
+            hs = self._vol_holders.get(k)
+            if hs is not None:
+                hs.discard(url)
+                if not hs:
+                    del self._vol_holders[k]
+            self._reindex_volume(*k)
 
     def snapshot_nodes(self) -> list[DataNode]:
         """Stable list of nodes for iteration outside the lock."""
@@ -179,8 +293,9 @@ class Topology:
 
     def unregister(self, url: str) -> None:
         with self._lock:
-            if self.nodes.pop(url, None) is not None:
-                self._rebuild_indexes()
+            node = self.nodes.pop(url, None)
+            if node is not None:
+                self._drop_node_from_indexes(node)
 
     def reap_dead_nodes(self, timeout: Optional[float] = None) -> list[str]:
         """Drop nodes whose heartbeats stopped (the failure detector)."""
@@ -192,38 +307,163 @@ class Topology:
         # reference-matching 25 s window.
         timeout = timeout if timeout is not None \
             else max(5 * self.pulse_seconds, 10.0)
-        now = time.time()
+        now = self.clock()
         with self._lock:
             dead = [u for u, n in self.nodes.items()
                     if now - n.last_seen > timeout]
             for u in dead:
-                del self.nodes[u]
-            if dead:
-                self._rebuild_indexes()
+                node = self.nodes.pop(u)
+                self._drop_node_from_indexes(node)
         for u in dead:
             self.telemetry.forget(u)
         return dead
 
+    # ---------------- index maintenance ----------------
+    #
+    # The shared indexes (``layouts``, ``ec_locations``) are maintained
+    # per-volume: any change to who holds (collection, vid) triggers a
+    # recompute of just that volume's entries from its current holders
+    # (at most replica-count nodes). A 2,000-node heartbeat sweep over
+    # an unchanged cluster therefore does zero index writes, where the
+    # old full ``_rebuild_indexes`` walked every volume on every node
+    # on every pulse — O(cluster) work per heartbeat.
+
+    def _reindex_volume(self, collection: str, vid: int) -> None:
+        """Recompute every index entry for one logical volume from the
+        node snapshots of its current holders (callers hold the lock)."""
+        k = (collection, vid)
+        per_key: dict[LayoutKey, tuple[set[str], int, bool]] = {}
+        for url in self._vol_holders.get(k, ()):
+            node = self.nodes.get(url)
+            v = node.volumes.get(k) if node is not None else None
+            if v is None:
+                continue
+            key = LayoutKey(collection, v.replica_placement, v.ttl)
+            urls, size, ro = per_key.get(key, (None, 0, False))
+            if urls is None:
+                urls = set()
+            urls.add(url)
+            per_key[key] = (urls, max(size, v.size), ro or v.read_only)
+        for key in self._vol_keys.get(k, set()) - set(per_key):
+            lay = self.layouts.get(key)
+            if lay is not None:
+                lay.locations.pop(vid, None)
+                lay.sizes.pop(vid, None)
+                lay.readonly.discard(vid)
+                if not lay.locations:
+                    del self.layouts[key]
+        for key, (urls, size, ro) in per_key.items():
+            lay = self.layouts.get(key)
+            if lay is None:
+                lay = self.layouts[key] = VolumeLayout(key)
+            lay.locations[vid] = urls
+            lay.sizes[vid] = size
+            if ro:
+                lay.readonly.add(vid)
+            else:
+                lay.readonly.discard(vid)
+        if per_key:
+            self._vol_keys[k] = set(per_key)
+        else:
+            self._vol_keys.pop(k, None)
+
+    def _reindex_ec(self, vid: int) -> None:
+        """Recompute the EC shard-location map for one volume id from
+        its current shard holders (callers hold the lock)."""
+        holders = self._ec_holders.get(vid)
+        if not holders:
+            self.ec_locations.pop(vid, None)
+            self.ec_collections.pop(vid, None)
+            return
+        shard_map: dict[int, set[str]] = {}
+        col = ""
+        for (url, c), bits in holders.items():
+            col = c
+            for sid in bits.ids():
+                shard_map.setdefault(sid, set()).add(url)
+        self.ec_locations[vid] = shard_map
+        self.ec_collections[vid] = col
+
+    def _drop_node_from_indexes(self, node: DataNode) -> None:
+        """Remove one (already unlinked) node's contribution — O(its
+        own volumes), not O(cluster) (callers hold the lock)."""
+        for k in node.volumes:
+            hs = self._vol_holders.get(k)
+            if hs is not None:
+                hs.discard(node.url)
+                if not hs:
+                    del self._vol_holders[k]
+            self._reindex_volume(*k)
+        for (col, vid) in node.ec_shards:
+            hmap = self._ec_holders.get(vid)
+            if hmap is not None:
+                hmap.pop((node.url, col), None)
+                if not hmap:
+                    del self._ec_holders[vid]
+            self._reindex_ec(vid)
+
     def _rebuild_indexes(self) -> None:
-        layouts: dict[LayoutKey, VolumeLayout] = {}
-        ec_locs: dict[int, dict[int, set[str]]] = {}
-        ec_cols: dict[int, str] = {}
-        for node in self.nodes.values():
-            for v in node.volumes.values():
-                key = LayoutKey(v.collection, v.replica_placement, v.ttl)
-                lay = layouts.setdefault(key, VolumeLayout(key))
-                lay.locations.setdefault(v.id, set()).add(node.url)
-                lay.sizes[v.id] = max(lay.sizes.get(v.id, 0), v.size)
-                if v.read_only:
-                    lay.readonly.add(v.id)
-            for (col, vid), bits in node.ec_shards.items():
-                shard_map = ec_locs.setdefault(vid, {})
-                ec_cols[vid] = col
-                for sid in bits.ids():
-                    shard_map.setdefault(sid, set()).add(node.url)
-        self.layouts = layouts
-        self.ec_locations = ec_locs
-        self.ec_collections = ec_cols
+        """Full recompute of every index from the node snapshots.
+
+        No longer on any hot path (delta maintenance replaced it); kept
+        as the ground truth that ``check_indexes`` — and any caller that
+        suspects drift — can rebuild from.
+        """
+        with self._lock:
+            self._vol_holders = {}
+            self._ec_holders = {}
+            for node in self.nodes.values():
+                for k in node.volumes:
+                    self._vol_holders.setdefault(k, set()).add(node.url)
+                for (col, vid), bits in node.ec_shards.items():
+                    self._ec_holders.setdefault(vid, {})[
+                        (node.url, col)] = bits
+            self.layouts = {}
+            self.ec_locations = {}
+            self.ec_collections = {}
+            self._vol_keys = {}
+            for k in list(self._vol_holders):
+                self._reindex_volume(*k)
+            for vid in list(self._ec_holders):
+                self._reindex_ec(vid)
+
+    def check_indexes(self, max_report: int = 20) -> list[str]:
+        """Compare the incrementally-maintained indexes against a from-
+        scratch recompute; return discrepancy descriptions (empty ==
+        consistent). The sim asserts this after every scenario wave."""
+        with self._lock:
+            want_lay: dict[LayoutKey, dict[int, set[str]]] = {}
+            want_ro: dict[LayoutKey, set[int]] = {}
+            want_sz: dict[LayoutKey, dict[int, int]] = {}
+            want_ec: dict[int, dict[int, set[str]]] = {}
+            for node in self.nodes.values():
+                for v in node.volumes.values():
+                    key = LayoutKey(v.collection, v.replica_placement,
+                                    v.ttl)
+                    want_lay.setdefault(key, {}).setdefault(
+                        v.id, set()).add(node.url)
+                    sz = want_sz.setdefault(key, {})
+                    sz[v.id] = max(sz.get(v.id, 0), v.size)
+                    if v.read_only:
+                        want_ro.setdefault(key, set()).add(v.id)
+                for (_col, vid), bits in node.ec_shards.items():
+                    m = want_ec.setdefault(vid, {})
+                    for sid in bits.ids():
+                        m.setdefault(sid, set()).add(node.url)
+            bad: list[str] = []
+            for key in set(want_lay) | set(self.layouts):
+                lay = self.layouts.get(key)
+                got = lay.locations if lay else {}
+                if got != want_lay.get(key, {}):
+                    bad.append(f"layout {key} locations drifted")
+                elif lay is not None and (
+                        lay.readonly != want_ro.get(key, set())
+                        or lay.sizes != want_sz.get(key, {})):
+                    bad.append(f"layout {key} readonly/sizes drifted")
+            if {vid: m for vid, m in self.ec_locations.items()} \
+                    != want_ec:
+                bad.append("ec_locations drifted")
+            return bad[:max_report]
 
     # ---------------- lookups ----------------
 

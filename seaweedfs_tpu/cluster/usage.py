@@ -468,8 +468,12 @@ class ClusterUsage:
                     agg["latency"].merge(row["latency"])
         return out
 
-    def to_map(self) -> dict:
-        """JSON body for ``/cluster/usage``."""
+    def to_map(self, limit: Optional[int] = None) -> dict:
+        """JSON body for ``/cluster/usage``.
+
+        ``limit`` caps the tenants section to the top-N by requests
+        (``tenants_total``/``tenants_omitted`` say what was dropped) —
+        a 2,000-source cluster must not render every tenant row."""
         now = self.clock()
         with self._lock:
             merged = self._merged_locked()
@@ -503,8 +507,15 @@ class ClusterUsage:
             for f in totals:
                 t[f] += b[f]
                 totals[f] += b[f]
-        return {"tenants": tenants, "totals": totals,
-                "sources": sources}
+        out = {"tenants": tenants, "totals": totals,
+               "sources": sources}
+        if limit is not None and 0 < limit < len(tenants):
+            top = sorted(tenants,
+                         key=lambda t: (-tenants[t]["requests"], t))
+            out["tenants"] = {t: tenants[t] for t in top[:limit]}
+            out["tenants_total"] = len(tenants)
+            out["tenants_omitted"] = len(tenants) - limit
+        return out
 
     def merged_topk(self) -> SpaceSaving:
         with self._lock:

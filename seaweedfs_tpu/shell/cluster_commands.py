@@ -1594,6 +1594,29 @@ def cmd_volume_fsck(env: ClusterEnv, argv: list[str]) -> None:
         + (" — some files are BROKEN" if missing else ""))
 
 
+class _CappedLines:
+    """Print at most ``limit`` detail lines; the summary keeps exact
+    totals. At simulation scale a sweep can find tens of thousands of
+    problems — render the head, say how much was cut."""
+
+    def __init__(self, env: ClusterEnv, limit: int):
+        self.env = env
+        self.limit = max(0, limit)
+        self.shown = 0
+        self.suppressed = 0
+
+    def println(self, line: str) -> None:
+        if self.shown < self.limit:
+            self.shown += 1
+            self.env.println(line)
+        else:
+            self.suppressed += 1
+
+    def footer(self) -> None:
+        if self.suppressed:
+            self.env.println(f"… {self.suppressed} more")
+
+
 @cluster_command("cluster.check")
 def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
     """Read-only cluster health sweep (the reference's cluster.check):
@@ -1602,7 +1625,11 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
     from ..storage.superblock import ReplicaPlacement
 
     p = _parser("cluster.check")
-    p.parse_args(argv)
+    p.add_argument("-n", type=int, default=50,
+                   help="max detail lines to print (counts stay "
+                        "exact; 0 = summary only)")
+    args = p.parse_args(argv)
+    out = _CappedLines(env, args.n)
     resp = env.volume_list()
     vols: dict[int, tuple[str, int, list[str]]] = {}
     node_racks: dict[str, tuple[str, str]] = {}
@@ -1616,7 +1643,7 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
                 if dn.max_volume_count and \
                         dn.volume_count >= dn.max_volume_count:
                     full_nodes += 1
-                    env.println(f"node {dn.id} at capacity "
+                    out.println(f"node {dn.id} at capacity "
                                 f"({dn.volume_count}/"
                                 f"{dn.max_volume_count})")
                 for v in dn.volume_infos:
@@ -1629,7 +1656,7 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
         rp = ReplicaPlacement.from_byte(rp_byte)
         want = rp.copy_count()
         if len(holders) < want:
-            env.println(f"volume {vid} under-replicated: "
+            out.println(f"volume {vid} under-replicated: "
                         f"{len(holders)}/{want} replicas")
             problems += 1
         elif len(holders) > 1:
@@ -1655,7 +1682,7 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
                     violated = (f"{len(rs)} replicas in DC {d} share "
                                 f"{len(set(rs))} rack(s)")
             if violated:
-                env.println(f"volume {vid} placement violation: "
+                out.println(f"volume {vid} placement violation: "
                             f"{violated} for placement {rp}")
                 problems += 1
     # EC: shard ids present anywhere per volume; a gap below the max id
@@ -1668,7 +1695,7 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
     for vid, sids in sorted(present.items()):
         gaps = sorted(set(range(max(sids) + 1)) - sids)
         if gaps:
-            env.println(f"ec volume {vid} missing shards {gaps} "
+            out.println(f"ec volume {vid} missing shards {gaps} "
                         f"(run ec.rebuild)")
             problems += 1
     # Node health verdicts from the telemetry plane, best-effort (an
@@ -1687,7 +1714,7 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
         line = f"node {url}: {h['verdict']} (score {h['score']})"
         if h.get("reasons"):
             line += " — " + "; ".join(h["reasons"])
-        env.println(line)
+        out.println(line)
         if h["verdict"] == "unhealthy":
             problems += 1
     # SLO burn-rate verdicts, same best-effort stance: a paging
@@ -1703,9 +1730,10 @@ def cmd_cluster_check(env: ClusterEnv, argv: list[str]) -> None:
             continue
         burns = ", ".join(f"{w}={r}" for w, r in
                           o.get("burn_rates", {}).items())
-        env.println(f"slo {name}: {o['state']} (burn {burns})")
+        out.println(f"slo {name}: {o['state']} (burn {burns})")
         if o["state"] == "page":
             problems += 1
+    out.footer()
     env.println(f"cluster.check: {n_nodes} nodes, {len(vols)} volumes, "
                 f"{len(present)} ec volumes, {problems} problems")
     if problems:
@@ -1995,6 +2023,7 @@ def cmd_volume_heatmap(env: ClusterEnv, argv: list[str]) -> None:
                 "misses": lambda r: r["misses"],
                 "p99": lambda r: r["p99"] or 0.0}[args.sortBy]
     rows.sort(key=sort_key, reverse=True)
+    total_rows = len(rows)
     rows = rows[:max(1, args.n)]
     top = max(sort_key(r) for r in rows) or 1.0
     env.println(f"{'volume':>8} {'collection':<12} {'node':<21} "
@@ -2010,17 +2039,23 @@ def cmd_volume_heatmap(env: ClusterEnv, argv: list[str]) -> None:
             f"{r['node']:<21} {_fmt_rate(r['reads']):>8} "
             f"{_fmt_rate(r['writes']):>8} {hitp:>6} "
             f"{_fmt_ms(r['p99']):>7}  {bar}")
+    if total_rows > len(rows):
+        env.println(f"… {total_rows - len(rows)} more rows")
     # What CODE is hot on each node: the continuous profiler's top
     # stacks ride the heartbeat telemetry (leaf frame shown; the full
-    # collapsed stacks come from /debug/profile on the node).
+    # collapsed stacks come from /debug/profile on the node). Capped
+    # at -n nodes: a thousand-node fleet renders a head, not a dump.
     hot = {url: n.get("hot_stacks") or []
            for url, n in doc.get("nodes", {}).items()}
     if any(hot.values()):
         env.println("hot code (continuous profiler, samples):")
-        for url in sorted(hot):
+        with_stacks = [u for u in sorted(hot) if hot[u]]
+        for url in with_stacks[:max(1, args.n)]:
             for s in hot[url][:3]:
                 leaf = s["stack"].rsplit(";", 1)[-1]
                 env.println(f"  {url:<21} {s['samples']:>7}  {leaf}")
+        if len(with_stacks) > args.n:
+            env.println(f"… {len(with_stacks) - args.n} more nodes")
 
 
 def _fmt_bytes(n: int) -> str:

@@ -1,0 +1,30 @@
+"""simweed: cluster-at-scale simulation harness (docs/simulation.md).
+
+One REAL :class:`~seaweedfs_tpu.cluster.master.MasterServer` — never
+started, so no sockets, no threads — is driven in-process by thousands
+of :class:`SimVolumeServer` state machines through the master's actual
+ingestion paths: ``topology.register_heartbeat``,
+``telemetry.ingest``, ``usage.ingest``, ``jobs.claim/renew/complete``
+and ``lookup``. Time is a :class:`VirtualClock` threaded through every
+master registry (they all take ``clock=``), so a six-hour SLO window
+plays out in seconds and every run is deterministic under ``--seed``.
+
+Scenario scripts (:mod:`seaweedfs_tpu.sim.scenario`) compose zipfian
+tenant traffic with failure waves from the fault catalog — rack loss,
+restart storms, counter regressions, slow-node latency injections,
+volume churn — and after each wave assert convergence invariants: no
+policy oscillation, bounded job queues, leases re-queued away from
+dead workers, SLO burn below paging, the cluster check healthy, and
+the topology's incremental indexes consistent with a from-scratch
+recompute (``Topology.check_indexes``).
+
+Entry points: ``python -m seaweedfs_tpu.sim --nodes 2000
+--volumes 1000000 --seed 7`` and ``scripts/sim_smoke.sh``.
+"""
+
+from .clock import VirtualClock
+from .nodes import SimVolumeServer
+from .scenario import SimCluster, default_scenario, run_scenario
+
+__all__ = ["VirtualClock", "SimVolumeServer", "SimCluster",
+           "default_scenario", "run_scenario"]

@@ -1,0 +1,96 @@
+"""``python -m seaweedfs_tpu.sim`` — run a cluster-at-scale scenario.
+
+Human-readable wave progress goes to stderr; the final report is one
+JSON document on stdout (machine-readable — the bench harness and
+``scripts/sim_smoke.sh`` parse it). Exit status is 0 iff every wave's
+invariants held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time as _time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m seaweedfs_tpu.sim",
+        description="Drive one real master with simulated volume "
+                    "servers through fault waves on a virtual clock.")
+    p.add_argument("--nodes", type=int, default=200,
+                   help="simulated volume servers (default 200)")
+    p.add_argument("--volumes", type=int, default=20_000,
+                   help="total volumes across the fleet "
+                        "(default 20000)")
+    p.add_argument("--seed", type=int, default=7,
+                   help="deterministic seed (default 7)")
+    p.add_argument("--pulse", type=float, default=5.0,
+                   help="heartbeat pulse seconds, virtual (default 5)")
+    p.add_argument("--tenants", type=int, default=8)
+    p.add_argument("--hot", type=int, default=32,
+                   help="size of the zipf-hot volume set (default 32)")
+    p.add_argument("--waves", default=None,
+                   help="comma-separated wave subset (default: all); "
+                        "see --list-waves")
+    p.add_argument("--scenario", default=None, metavar="FILE.json",
+                   help="scenario script (JSON list of wave specs) "
+                        "instead of the built-in default")
+    p.add_argument("--policy-interval", type=float, default=30.0,
+                   help="policy tick interval, virtual seconds "
+                        "(default 30)")
+    p.add_argument("--no-bench", action="store_true",
+                   help="skip the master-ceiling measurements")
+    p.add_argument("--verbose", action="store_true",
+                   help="keep master INFO/WARNING logs (noisy: the "
+                        "sim injects faults the master logs about)")
+    p.add_argument("--list-waves", action="store_true")
+    args = p.parse_args(argv)
+
+    # Importing here keeps --help/--list-waves instant and lets the
+    # log level land before any master module logs.
+    from .scenario import (WAVES, SimCluster, default_scenario,
+                           run_scenario)
+    if args.list_waves:
+        print("\n".join(WAVES))
+        return 0
+    if not args.verbose:
+        # Faults are the point; a million injected-failure log lines
+        # are not. --verbose restores them.
+        logging.getLogger("seaweedfs_tpu").setLevel(logging.ERROR)
+
+    if args.scenario:
+        with open(args.scenario, encoding="utf-8") as f:
+            scenario = json.load(f)
+        if not isinstance(scenario, list):
+            p.error(f"{args.scenario}: scenario must be a JSON list "
+                    f"of wave specs")
+    else:
+        waves = (args.waves.split(",") if args.waves else None)
+        scenario = default_scenario(waves)
+
+    log = lambda s: print(s, file=sys.stderr, flush=True)  # noqa: E731
+    t0 = _time.perf_counter()
+    log(f"sim: building {args.nodes} nodes / {args.volumes} volumes "
+        f"(seed {args.seed})...")
+    cluster = SimCluster(
+        nodes=args.nodes, volumes=args.volumes, seed=args.seed,
+        pulse_seconds=args.pulse, tenants=args.tenants,
+        hot_count=args.hot, policy_interval=args.policy_interval)
+    log(f"sim: built in {_time.perf_counter() - t0:.1f}s; "
+        f"{len(scenario)} wave(s): "
+        + ", ".join(s["wave"] for s in scenario))
+    report = run_scenario(cluster, scenario, log=log,
+                          with_bench=not args.no_bench)
+    report["wall_seconds"] = round(_time.perf_counter() - t0, 1)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    verdict = "ALL WAVES OK" if report["ok"] else "INVARIANT FAILURES"
+    log(f"sim: {verdict} in {report['wall_seconds']}s wall "
+        f"({report['virtual_seconds']}s virtual)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

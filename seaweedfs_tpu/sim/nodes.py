@@ -1,0 +1,198 @@
+"""Simulated volume servers: in-process state machines, no disks.
+
+Each :class:`SimVolumeServer` is a few dicts — its volume snapshot,
+its EC shard bits, cumulative telemetry counters — plus the behaviors
+the master actually observes from a real server:
+
+- **heartbeat**: hands the topology a full snapshot through
+  ``Topology.register_heartbeat``, using the pre-keyed-dict adoption
+  path and the VolumeInfo immutability contract (stats changes replace
+  the object, steady state reuses it) so an unchanged pulse rides the
+  master's identity fast path.
+- **telemetry**: builds a real ``master_pb2.TelemetrySnapshot`` for
+  the volumes that saw traffic this window — cumulative counters,
+  latency digests scaled by ``latency_scale`` (the slow-node fault
+  injection), cache hits per the volume's warmth.
+- **job-lease worker**: claims tasks from the real ``JobManager``,
+  applies their effect to its own volume dict (EC seal, replica copy,
+  replica drop, vacuum) and completes them — or, when told to die
+  mid-lease, silently keeps the lease so expiry has to re-queue it.
+
+A restart (``restart()``) zeroes the cumulative telemetry counters —
+exactly the counter regression the master-side registry must treat as
+a fresh baseline.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..cluster.topology import Topology, VolumeInfo
+from ..pb import master_pb2
+from ..util.stats import Digest
+
+#: All 14 shards of the default RS(10,4) scheme present on one node —
+#: what a freshly sealed (unspread) EC volume's shard bits look like.
+ALL_SHARD_BITS = (1 << 14) - 1
+
+#: Digest centroid budget for simulated latency sketches (small: each
+#: window carries only a handful of synthetic samples).
+_SIM_CENTROIDS = 32
+
+
+class SimVolumeServer:
+    """One simulated node. Pure state machine — no sockets, no disk."""
+
+    def __init__(self, url: str, data_center: str, rack: str,
+                 max_volume_count: int, seed: int,
+                 base_latency: float = 0.004):
+        self.url = url
+        self.data_center = data_center
+        self.rack = rack
+        self.max_volume_count = max_volume_count
+        self.rng = random.Random(seed)
+        self.base_latency = base_latency
+        #: The node's authoritative volume snapshot. The topology holds
+        #: a reference to a *copy* handed over at heartbeat time, so
+        #: this dict is free to mutate between pulses.
+        self.volumes: dict[tuple[str, int], VolumeInfo] = {}
+        self.ec: dict[tuple[str, int], int] = {}   # (col, vid) -> bits
+        self.alive = True
+        #: Latency injection: read latencies are multiplied by this
+        #: (slow-node wave sets it >> 1).
+        self.latency_scale = 1.0
+        #: Cumulative per-volume counters since "process start".
+        self._cum_reads: dict[int, int] = {}
+        self._cum_hits: dict[int, int] = {}
+        self._cum_misses: dict[int, int] = {}
+        self.restarts = 0
+        self.heartbeats_sent = 0
+        self.tasks_completed = 0
+
+    # ---------------- volume management ----------------
+
+    def add_volume(self, vid: int, collection: str = "",
+                   size: int = 0, read_only: bool = False,
+                   replica_placement: str = "000") -> VolumeInfo:
+        v = VolumeInfo(id=vid, collection=collection, size=size,
+                       read_only=read_only,
+                       replica_placement=replica_placement)
+        self.volumes[(collection, vid)] = v
+        return v
+
+    def drop_volume(self, vid: int, collection: str = "") -> bool:
+        return self.volumes.pop((collection, vid), None) is not None
+
+    # ---------------- fault injection ----------------
+
+    def restart(self) -> None:
+        """Process restart: cumulative telemetry counters reset (the
+        counter regression the master must re-baseline), volumes
+        survive (they live on 'disk')."""
+        self.restarts += 1
+        self._cum_reads.clear()
+        self._cum_hits.clear()
+        self._cum_misses.clear()
+
+    # ---------------- heartbeat ----------------
+
+    def heartbeat(self, topo: Topology) -> None:
+        """Full-snapshot pulse into the real topology. Hands over a
+        fresh dict copy (the adoption contract) so later mutation of
+        ``self.volumes`` never aliases the master's view."""
+        if not self.alive:
+            return
+        self.heartbeats_sent += 1
+        topo.register_heartbeat(
+            self.url, public_url=self.url,
+            data_center=self.data_center, rack=self.rack,
+            max_volume_count=self.max_volume_count,
+            volumes=dict(self.volumes),
+            ec_shards=[(c, vid, bits)
+                       for (c, vid), bits in self.ec.items()])
+
+    # ---------------- telemetry ----------------
+
+    def telemetry_snapshot(self, loads: dict[int, int], window: float,
+                           warmth: float = 0.0,
+                           errors: Optional[dict[int, int]] = None
+                           ) -> Optional[master_pb2.TelemetrySnapshot]:
+        """A wire snapshot for the volumes that saw traffic.
+
+        ``loads`` maps volume id -> read ops this window; ``warmth``
+        is the fraction served from the chunk cache. Latency samples
+        are drawn around ``base_latency * latency_scale``. Returns
+        None when nothing happened (a real collector ships an empty
+        snapshot; skipping it entirely keeps the sim's proto cost
+        proportional to traffic, and the master decays absentees)."""
+        if not loads:
+            return None
+        errors = errors or {}
+        snap = master_pb2.TelemetrySnapshot(
+            window_ns=max(1, int(window * 1e9)))
+        lat = self.base_latency * self.latency_scale
+        for vid, ops in loads.items():
+            reads = self._cum_reads[vid] = \
+                self._cum_reads.get(vid, 0) + ops
+            hit = int(ops * warmth)
+            hits = self._cum_hits[vid] = \
+                self._cum_hits.get(vid, 0) + hit
+            misses = self._cum_misses[vid] = \
+                self._cum_misses.get(vid, 0) + (ops - hit)
+            m = snap.volumes.add(
+                volume_id=vid, read_ops=reads,
+                cache_hits=hits, cache_misses=misses,
+                errors=errors.get(vid, 0))
+            d = Digest(_SIM_CENTROIDS)
+            for _ in range(min(8, max(2, ops // 4))):
+                d.add(max(1e-4, self.rng.gauss(lat, lat * 0.25)))
+            m.read_latency.CopyFrom(d.to_proto())
+        return snap
+
+    # ---------------- job-lease worker ----------------
+
+    def poll_jobs(self, ms, catalog: dict,
+                  abandon: bool = False) -> Optional[dict]:
+        """One worker poll against the real JobManager: claim, apply
+        the task's effect to the local state, heartbeat the change in,
+        complete. With ``abandon`` the claim is taken but never
+        completed — the lease-expiry path has to re-queue it.
+        ``catalog`` maps vid -> template VolumeInfo (what a replicate
+        copy should look like)."""
+        task = ms.jobs.claim(self.url)
+        if task is None:
+            return None
+        if abandon or not self.alive:
+            return task
+        vid = int(task["volumeId"])
+        col = task.get("collection", "")
+        kind = task["kind"]
+        k = (col, vid)
+        if kind == "ec_encode":
+            self.volumes.pop(k, None)
+            self.ec[k] = ALL_SHARD_BITS
+        elif kind == "replicate":
+            tmpl = catalog.get(vid)
+            self.volumes[k] = VolumeInfo(
+                id=vid, collection=col,
+                size=tmpl.size if tmpl else 0,
+                read_only=tmpl.read_only if tmpl else False,
+                replica_placement=tmpl.replica_placement
+                if tmpl else "000")
+        elif kind == "replica_drop":
+            self.volumes.pop(k, None)
+        elif kind == "vacuum":
+            v = self.volumes.get(k)
+            if v is not None:
+                self.volumes[k] = VolumeInfo(
+                    id=v.id, collection=v.collection, size=v.size,
+                    file_count=v.file_count, delete_count=0,
+                    deleted_byte_count=0, read_only=v.read_only,
+                    replica_placement=v.replica_placement,
+                    version=v.version, ttl=v.ttl,
+                    modified_at_second=v.modified_at_second)
+        self.heartbeat(ms.topology)
+        ms.jobs.complete(self.url, task["taskId"], True)
+        self.tasks_completed += 1
+        return task

@@ -1,0 +1,674 @@
+"""Scenario engine: waves of faults against one real master.
+
+A **scenario** is a list of wave specs — ``[{"wave": "rack_loss",
+"ticks": 8}, ...]`` (docs/simulation.md documents the format and how
+to add a wave). :func:`run_scenario` plays them against a
+:class:`SimCluster` and asserts the convergence invariants after each
+wave:
+
+- ``indexes``     — ``Topology.check_indexes()`` finds no drift
+  between the incrementally-maintained layouts/EC maps and a
+  from-scratch recompute;
+- ``oscillation`` — every policy action respects the hysteresis band
+  (replicate only at/above the grow threshold, replica_drop only
+  at/below the cool threshold) and per-volume actions are spaced by
+  the cooldown dwell;
+- ``queues``      — non-terminal maintenance tasks stay bounded;
+- ``leases``      — no lease is held by a dead or reaped worker;
+- ``slo``         — no objective is in the paging state;
+- ``health``      — replica counts meet placement, EC volumes have no
+  shard-id gaps, and no live node's telemetry verdict is unhealthy
+  (the in-process equivalent of shell ``cluster.check``).
+
+The sim tick is two master pulses of virtual time: every alive node
+heartbeats (unchanged snapshots ride the topology's identity fast
+path), zipfian traffic lands in the telemetry/usage planes, and the
+master's reap-loop duties run — dead-node reaping, lease expiry,
+policy ticks, SLO evaluation — followed by targeted job-worker polls.
+"""
+
+from __future__ import annotations
+
+import random
+import time as _time
+from typing import Callable, Optional
+
+from ..cluster.master import MasterServer
+from ..cluster.topology import VolumeInfo
+from ..storage.superblock import ReplicaPlacement
+from ..util import profiler
+from .clock import VirtualClock
+from .nodes import SimVolumeServer
+from .traffic import TenantTraffic
+
+#: Wave registry: name -> SimCluster method. Scenario specs refer to
+#: these names; add a wave by writing a ``wave_<name>`` method and
+#: listing it here (docs/simulation.md walks through it).
+WAVES = ("traffic_shift", "rack_loss", "restart_storm",
+         "counter_regression", "slow_nodes", "volume_churn")
+
+
+def default_scenario(waves: Optional[list[str]] = None) -> list[dict]:
+    """The standard six-wave script (subset via ``waves``)."""
+    script = [
+        {"wave": "traffic_shift", "hot_ticks": 10, "cool_ticks": 18,
+         "ops": 4000},
+        {"wave": "rack_loss", "outage_ticks": 5, "recovery_ticks": 6},
+        {"wave": "restart_storm", "fraction": 0.2, "ticks": 6},
+        {"wave": "counter_regression", "fraction": 0.3, "ticks": 6},
+        {"wave": "slow_nodes", "count": 3, "slow_ticks": 8,
+         "recovery_ticks": 36},
+        {"wave": "volume_churn", "fraction": 0.05, "ticks": 8},
+    ]
+    if waves is not None:
+        allow = set(waves)
+        unknown = allow - set(WAVES)
+        if unknown:
+            raise ValueError(f"unknown wave(s) {sorted(unknown)}; "
+                             f"known: {', '.join(WAVES)}")
+        script = [s for s in script if s["wave"] in allow]
+    return script
+
+
+class SimCluster:
+    """N simulated volume servers driving one real, unstarted master.
+
+    ``MasterServer`` is constructed but ``start()`` is never called:
+    no gRPC/HTTP sockets, no reaper/HA/SLO threads — the sim performs
+    the reap-loop duties itself on virtual time.
+    """
+
+    def __init__(self, nodes: int = 200, volumes: int = 20_000,
+                 seed: int = 7, pulse_seconds: float = 5.0,
+                 data_centers: int = 2, racks_per_dc: int = 4,
+                 tenants: int = 8, hot_count: int = 32,
+                 ec_candidates: int = 6,
+                 policy_interval: float = 30.0):
+        if nodes < data_centers * racks_per_dc:
+            racks_per_dc = max(1, nodes // max(1, data_centers))
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.clock = VirtualClock()
+        self.pulse = pulse_seconds
+        #: One tick advances two pulses: half the heartbeat sweeps of
+        #: per-pulse ticking, still far inside the 5-pulse reap window.
+        self.tick_dt = 2.0 * pulse_seconds
+        self.ms = MasterServer(pulse_seconds=pulse_seconds, seed=seed,
+                               clock=self.clock.time)
+        self.ms.policy.enabled = True
+        self.ms.policy.interval = policy_interval
+        self.ms.slo.configure({"enabled": True, "read_p99_ms": 60.0,
+                               "availability": 0.999})
+        # ---- build nodes ----
+        self.nodes: list[SimVolumeServer] = []
+        self.by_url: dict[str, SimVolumeServer] = {}
+        per_node = max(1, volumes // max(1, nodes))
+        for i in range(nodes):
+            dc = f"dc{i % data_centers}"
+            rack = f"r{(i // data_centers) % racks_per_dc}"
+            n = SimVolumeServer(
+                url=f"sim-{i}:8080", data_center=dc, rack=rack,
+                max_volume_count=per_node + 8,
+                seed=self.rng.randrange(1 << 30))
+            self.nodes.append(n)
+            self.by_url[n.url] = n
+        # ---- build volumes ----
+        #: vid -> template VolumeInfo (what a replicate copy mirrors).
+        self.catalog: dict[int, VolumeInfo] = {}
+        self.next_vid = 1
+        for _ in range(volumes):
+            vid = self.next_vid
+            self.next_vid += 1
+            node = self.nodes[vid % nodes]
+            read_only = vid <= ec_candidates
+            v = node.add_volume(vid, size=self.rng.randrange(1 << 20),
+                                read_only=read_only)
+            self.catalog[vid] = v
+        #: Hot set skips the EC candidates (those must stay cold).
+        hot = [vid for vid in range(ec_candidates + 1,
+                                    ec_candidates + 1 + hot_count)
+               if vid in self.catalog]
+        self.traffic = TenantTraffic(tenants, hot, seed=seed + 1)
+        self.ticks = 0
+        self.churned_total = 0
+        self._first_sweep()
+
+    # ---------------- plumbing ----------------
+
+    def _first_sweep(self) -> None:
+        """Register every node before the clock moves (the build
+        heartbeat sweep — the only O(cluster) index work in a run)."""
+        for n in self.nodes:
+            n.heartbeat(self.ms.topology)
+
+    def alive_nodes(self) -> list[SimVolumeServer]:
+        return [n for n in self.nodes if n.alive]
+
+    def tick(self, ops: int = 0, warmth: float = 0.25,
+             heartbeats: bool = True) -> None:
+        """One simulated interval: advance time, heartbeat sweep,
+        traffic, master reap-loop duties, worker polls."""
+        self.ticks += 1
+        self.clock.advance(self.tick_dt)
+        ms = self.ms
+        if heartbeats:
+            for n in self.nodes:
+                n.heartbeat(ms.topology)
+        if ops:
+            loads = self.traffic.tick(ops)
+            per_node: dict[str, dict[int, int]] = {}
+            for vid, count in loads.items():
+                tmpl = self.catalog.get(vid)
+                holders = ms.topology.lookup_volume(
+                    vid, tmpl.collection if tmpl else "")
+                live = [h for h in holders
+                        if self.by_url.get(h.url) is not None
+                        and self.by_url[h.url].alive]
+                if not live:
+                    continue
+                share = max(1, count // len(live))
+                for h in live:
+                    per_node.setdefault(h.url, {})[vid] = share
+            for url, node_loads in per_node.items():
+                snap = self.by_url[url].telemetry_snapshot(
+                    node_loads, self.tick_dt, warmth=warmth)
+                if snap is not None:
+                    ms.topology.telemetry.ingest(url, snap,
+                                                 metrics=ms.metrics)
+            ms.usage.ingest("sim-gw:8333", self.traffic.usage_payload())
+        # The master's reap-loop duties, on virtual time:
+        dead = ms.topology.reap_dead_nodes()
+        for url in dead:
+            ms.usage.forget(url)
+            ms.jobs.forget_worker(url)
+        ms.jobs.expire()
+        ms.policy.maybe_tick()
+        ms.slo.evaluate()
+        self.drive_workers()
+
+    # ---------------- job workers ----------------
+
+    def _pending_tasks(self) -> list[dict]:
+        doc = self.ms.jobs.to_map(with_tasks=True)
+        out = []
+        for job in doc["jobs"]:
+            if job["state"] != "active":
+                continue
+            for t in job.get("tasks", ()):
+                if t["state"] == "pending":
+                    out.append(t)
+        return out
+
+    def _pick_worker(self, task: dict) -> Optional[SimVolumeServer]:
+        vid = int(task["volumeId"])
+        col = task.get("collection", "")
+        holders = [self.by_url[n.url]
+                   for n in self.ms.topology.lookup_volume(vid, col)
+                   if n.url in self.by_url]
+        holders = [h for h in holders if h.alive]
+        if task["kind"] == "replicate":
+            holder_urls = {h.url for h in holders}
+            pool = [n for n in self.nodes
+                    if n.alive and n.url not in holder_urls
+                    and len(n.volumes) < n.max_volume_count]
+            return self.rng.choice(pool) if pool else None
+        excluded = set(task.get("excluded") or ())
+        holders = [h for h in holders if h.url not in excluded]
+        return holders[0] if holders else None
+
+    def drive_workers(self, rounds: int = 3) -> int:
+        """Targeted worker polls until the pending queue drains or
+        stalls (a task whose only eligible holders are dead stalls —
+        lease expiry and revival waves own that)."""
+        done = 0
+        for _ in range(rounds):
+            pending = self._pending_tasks()
+            if not pending:
+                break
+            progress = False
+            for t in pending:
+                worker = self._pick_worker(t)
+                if worker is None:
+                    continue
+                if worker.poll_jobs(self.ms, self.catalog) is not None:
+                    progress = True
+                    done += 1
+            if not progress:
+                break
+        return done
+
+    # ---------------- invariants ----------------
+
+    def check_invariants(self, allow_unhealthy: frozenset = frozenset(),
+                         max_queue: int = 64) -> list[str]:
+        """The post-wave convergence sweep; returns problem strings
+        (empty == converged). This is shell ``cluster.check`` plus the
+        sim-only index/oscillation/lease checks, computed in-process."""
+        ms = self.ms
+        topo = ms.topology
+        problems: list[str] = []
+        # 1. incremental indexes vs ground truth
+        problems += [f"indexes: {s}" for s in topo.check_indexes()]
+        # 2. policy hysteresis: actions on the right side of the band,
+        #    per-volume spacing >= cooldown
+        pol = ms.policy
+        by_vid: dict[int, list[dict]] = {}
+        for a in list(pol.actions):
+            by_vid.setdefault(a["volumeId"], []).append(a)
+            rate = a["readRate"]
+            if a["action"] == "replicate" \
+                    and rate < pol.cool_read_rate - 1e-9:
+                problems.append(
+                    f"oscillation: replicate volume {a['volumeId']} "
+                    f"at rate {rate} below the hysteresis band "
+                    f"({pol.cool_read_rate})")
+            if a["action"] == "replica_drop" \
+                    and rate > pol.cool_read_rate + 1e-9:
+                problems.append(
+                    f"oscillation: replica_drop volume "
+                    f"{a['volumeId']} at rate {rate} above the cool "
+                    f"threshold ({pol.cool_read_rate})")
+        for vid, acts in by_vid.items():
+            acts.sort(key=lambda a: a["ts"])
+            for prev, cur in zip(acts, acts[1:]):
+                gap = cur["ts"] - prev["ts"]
+                if gap < pol.cooldown - 1e-6:
+                    problems.append(
+                        f"oscillation: volume {vid} acted on twice "
+                        f"within the cooldown ({gap:.0f}s < "
+                        f"{pol.cooldown:.0f}s)")
+        # 3. bounded queues + 4. leases never held by dead workers
+        live = 0
+        doc = ms.jobs.to_map(with_tasks=True)
+        for job in doc["jobs"]:
+            for t in job.get("tasks", ()):
+                if t["state"] not in ("pending", "leased"):
+                    continue
+                live += 1
+                if t["state"] != "leased":
+                    continue
+                w = t["worker"]
+                sim = self.by_url.get(w)
+                if w not in topo.nodes or sim is None or not sim.alive:
+                    problems.append(
+                        f"leases: task {t['taskId']} leased to "
+                        f"dead/reaped worker {w}")
+        if live > max_queue:
+            problems.append(f"queues: {live} non-terminal tasks "
+                            f"(bound {max_queue})")
+        # 5. SLO burn below paging
+        slo = ms.slo.payload()
+        for name, o in slo["objectives"].items():
+            if o["state"] == "page":
+                problems.append(
+                    f"slo: {name} paging (burn "
+                    f"{o.get('burn_rates')})")
+        # 6. cluster health: replicas meet placement, EC complete,
+        #    live nodes not unhealthy
+        with topo._lock:
+            for key, lay in topo.layouts.items():
+                want = ReplicaPlacement.parse(
+                    key.replication).copy_count()
+                for vid, urls in lay.locations.items():
+                    if len(urls) < want:
+                        problems.append(
+                            f"health: volume {vid} under-replicated "
+                            f"({len(urls)}/{want})")
+            for vid, shard_map in topo.ec_locations.items():
+                if not shard_map:
+                    continue
+                gaps = sorted(set(range(max(shard_map) + 1))
+                              - set(shard_map))
+                if gaps:
+                    problems.append(f"health: ec volume {vid} missing "
+                                    f"shards {gaps}")
+        tele = topo.telemetry
+        for n in topo.snapshot_nodes():
+            if n.url in allow_unhealthy:
+                continue
+            h = tele.health(n.url, n.last_seen, self.pulse)
+            if h["verdict"] == "unhealthy":
+                problems.append(
+                    f"health: node {n.url} unhealthy "
+                    f"(score {h['score']}: "
+                    f"{'; '.join(h['reasons'])})")
+        return problems
+
+    # ---------------- waves ----------------
+
+    def wave_traffic_shift(self, hot_ticks: int = 10,
+                           cool_ticks: int = 18,
+                           ops: int = 4000) -> dict:
+        """Zipfian tenant traffic heats one volume set (policy grows
+        replicas), shifts to a second set, then cools — the classic
+        oscillation bait the hysteresis band must absorb."""
+        for _ in range(hot_ticks):
+            self.tick(ops=ops)
+        # shift the zipf head to a fresh hot set
+        old = list(self.traffic.hot_volumes)
+        pool = [vid for vid in self.catalog
+                if vid not in set(old)][:len(old)]
+        self.traffic.hot_volumes = pool or old
+        for _ in range(hot_ticks):
+            self.tick(ops=ops)
+        # cool: a trickle keeps nodes heartbeating, rates decay
+        for _ in range(cool_ticks):
+            self.tick(ops=ops // 20)
+        return {"replicate_actions": sum(
+            1 for a in self.ms.policy.actions
+            if a["action"] == "replicate")}
+
+    def wave_rack_loss(self, outage_ticks: int = 5,
+                       recovery_ticks: int = 6) -> dict:
+        """A whole rack stops heartbeating mid-lease: the nodes must
+        be reaped, their leases re-queued with the dead workers
+        excluded, and the revived rack must converge back in."""
+        ms = self.ms
+        dc0 = self.nodes[0].data_center
+        r0 = self.nodes[0].rack
+        rack = [n for n in self.nodes
+                if n.data_center == dc0 and n.rack == r0 and n.alive]
+        # park a lease on each doomed node: a vacuum job over volumes
+        # the rack holds (only the holder is eligible, so the re-queue
+        # must wait for revival — exactly the stall we then heal)
+        vids = [next(iter(n.volumes))[1] for n in rack[:4]
+                if n.volumes]
+        leased = []
+        park_job = None
+        if vids:
+            park_job = ms.jobs.submit("vacuum", vids,
+                                      submitted_by="sim")["jobId"]
+            for n in rack[:4]:
+                t = n.poll_jobs(ms, self.catalog, abandon=True)
+                if t:
+                    leased.append((t["taskId"], n.url))
+        for n in rack:
+            n.alive = False
+        for _ in range(outage_ticks):
+            self.tick(ops=500)
+        reaped = [n.url for n in rack if n.url not in ms.topology.nodes]
+        problems = []
+        if len(reaped) != len(rack):
+            problems.append(f"rack_loss: only {len(reaped)}/{len(rack)}"
+                            f" dead nodes reaped")
+        # leases must have left the dead workers (re-queued, excluded)
+        doc = ms.jobs.to_map(with_tasks=True)
+        for job in doc["jobs"]:
+            for t in job.get("tasks", ()):
+                if t["state"] == "leased" and \
+                        self.by_url.get(t["worker"]) is not None and \
+                        not self.by_url[t["worker"]].alive:
+                    problems.append(f"rack_loss: task {t['taskId']} "
+                                    f"still leased to dead "
+                                    f"{t['worker']}")
+        for task_id, url in leased:
+            for job in doc["jobs"]:
+                for t in job.get("tasks", ()):
+                    if t["taskId"] == task_id and \
+                            url not in (t.get("excluded") or ()):
+                        problems.append(
+                            f"rack_loss: {task_id} re-queued without "
+                            f"excluding dead worker {url}")
+        # The re-queued vacuums excluded their only holder ("000"
+        # volumes), so they can never complete — cancel the probe job
+        # once the re-queue behavior is asserted.
+        if park_job is not None:
+            ms.jobs.cancel(park_job)
+        # revival: same volumes come back, counters reset
+        for n in rack:
+            n.alive = True
+            n.restart()
+        for _ in range(recovery_ticks):
+            self.tick(ops=500)
+        return {"rack": f"{dc0}/{r0}", "killed": len(rack),
+                "reaped": len(reaped), "parked_leases": len(leased),
+                "problems": problems}
+
+    def wave_restart_storm(self, fraction: float = 0.2,
+                           ticks: int = 6) -> dict:
+        """A slice of the fleet restarts: heartbeats gap for a tick
+        and every cumulative counter regresses to zero. Rates must
+        re-baseline (never go negative) and unchanged-topology pulses
+        must keep riding the fast path."""
+        ms = self.ms
+        storm = [n for n in self.alive_nodes()
+                 if self.rng.random() < fraction]
+        for n in storm:
+            n.alive = False
+        self.tick(ops=1000)          # one gapped tick — no reap yet
+        for n in storm:
+            n.alive = True
+            n.restart()
+        unchanged_before = ms.topology.heartbeats_unchanged
+        for _ in range(ticks):
+            self.tick(ops=1000)
+        problems = []
+        with ms.topology.telemetry._lock:
+            for url, agg in ms.topology.telemetry._nodes.items():
+                for vid, v in agg.volumes.items():
+                    bad = [f for f, r in v.rates.items() if r < -1e-9]
+                    if bad:
+                        problems.append(
+                            f"restart_storm: negative {bad} rate on "
+                            f"{url} volume {vid}")
+        gained = ms.topology.heartbeats_unchanged - unchanged_before
+        if gained <= 0:
+            problems.append("restart_storm: no heartbeat took the "
+                            "unchanged-topology fast path")
+        return {"restarted": len(storm),
+                "unchanged_fast_path": gained, "problems": problems}
+
+    def wave_counter_regression(self, fraction: float = 0.3,
+                                ticks: int = 6) -> dict:
+        """Counters regress with NO heartbeat gap (an in-place restart
+        the staleness detector never sees) — the registry must treat
+        the new cumulative value as a fresh baseline."""
+        hit = [n for n in self.alive_nodes()
+               if self.rng.random() < fraction]
+        for n in hit:
+            n.restart()
+        for _ in range(ticks):
+            self.tick(ops=1500)
+        problems = []
+        rates = self.ms.topology.telemetry.volume_read_rates()
+        for vid, r in rates.items():
+            if r < -1e-9:
+                problems.append(f"counter_regression: volume {vid} "
+                                f"read rate {r} negative")
+        return {"regressed": len(hit), "problems": problems}
+
+    def wave_slow_nodes(self, count: int = 3, slow_ticks: int = 8,
+                        recovery_ticks: int = 36,
+                        scale: float = 25.0) -> dict:
+        # recovery_ticks * tick_dt must exceed the telemetry digest
+        # window (default 300s) or the last slow-latency sketch never
+        # ages out and the merged p99 stays poisoned.
+        """Latency injection on hot-volume holders: their p99 blows
+        past the cluster median, health degrades, lookup ranking must
+        demote them — then recovery must pull SLO burn back below the
+        paging thresholds."""
+        ms = self.ms
+        hot = self.traffic.hot_volumes
+        slow: list[SimVolumeServer] = []
+        for vid in hot:
+            if len(slow) >= count:
+                break
+            for n in ms.topology.lookup_volume(vid):
+                sim = self.by_url.get(n.url)
+                if sim is not None and sim.alive and sim not in slow:
+                    sim.latency_scale = scale
+                    slow.append(sim)
+                    break
+        for _ in range(slow_ticks):
+            self.tick(ops=3000)
+        problems = []
+        slow_urls = {n.url for n in slow}
+        demoted = degraded = 0
+        for n in slow:
+            h = ms.topology.telemetry.health(
+                n.url, ms.topology.nodes[n.url].last_seen, self.pulse)
+            if h["verdict"] != "healthy":
+                degraded += 1
+        if slow and not degraded:
+            problems.append("slow_nodes: no injected node left the "
+                            "healthy verdict")
+        # ranked lookups put a slow holder last among 2+ replicas
+        for vid in hot:
+            locs = ms.lookup(vid)
+            if len(locs) < 2:
+                continue
+            urls = [loc["url"] for loc in locs]
+            if urls[0] in slow_urls and \
+                    any(u not in slow_urls for u in urls[1:]):
+                problems.append(f"slow_nodes: slow replica {urls[0]} "
+                                f"ranked first for volume {vid}")
+            if any(u in slow_urls for u in urls):
+                demoted += 1
+        for n in slow:
+            n.latency_scale = 1.0
+        for _ in range(recovery_ticks):
+            self.tick(ops=3000)
+        return {"slowed": len(slow), "left_healthy": degraded,
+                "ranked_lookups_touched": demoted, "problems": problems}
+
+    def wave_volume_churn(self, fraction: float = 0.05,
+                          ticks: int = 8) -> dict:
+        """Bulk volume turnover: every tick, ``fraction`` of each
+        sampled node's volumes are deleted and replaced with fresh
+        ids. The incremental indexes must track every transition."""
+        ms = self.ms
+        churned = 0
+        sample_vids: list[int] = []
+        removed_vids: list[int] = []
+        for _ in range(ticks):
+            for n in self.alive_nodes():
+                keys = list(n.volumes)
+                k = max(1, int(len(keys) * fraction))
+                for key in self.rng.sample(keys, min(k, len(keys))):
+                    col, vid = key
+                    n.drop_volume(vid, col)
+                    self.catalog.pop(vid, None)
+                    removed_vids.append(vid)
+                    new_vid = self.next_vid
+                    self.next_vid += 1
+                    self.catalog[new_vid] = n.add_volume(
+                        new_vid, size=self.rng.randrange(1 << 20))
+                    sample_vids.append(new_vid)
+                    churned += 2
+            self.tick(ops=500)
+        self.churned_total += churned
+        problems = []
+        for vid in sample_vids[-5:]:
+            if not ms.topology.lookup_volume(vid):
+                problems.append(f"volume_churn: new volume {vid} "
+                                f"not resolvable")
+        for vid in removed_vids[-5:]:
+            if vid in self.catalog:
+                continue    # id may have been reused by a later add
+            if ms.topology.lookup_volume(vid):
+                problems.append(f"volume_churn: removed volume {vid} "
+                                f"still resolvable")
+        return {"churn_events": churned, "problems": problems}
+
+    # ---------------- bench ----------------
+
+    def bench(self, lookup_samples: int = 2000,
+              sweeps: int = 3) -> dict:
+        """Wall-clock measurements of the master's hot paths at this
+        scale — persisted as the ``sim`` bench stage."""
+        ms = self.ms
+        # heartbeat ingestion throughput (steady-state fast path)
+        alive = self.alive_nodes()
+        t0 = _time.perf_counter()
+        for _ in range(sweeps):
+            self.clock.advance(self.pulse)
+            for n in alive:
+                n.heartbeat(ms.topology)
+        hb_elapsed = _time.perf_counter() - t0
+        hb_rate = (sweeps * len(alive)) / max(hb_elapsed, 1e-9)
+        # policy tick latency (full cluster fold)
+        t0 = _time.perf_counter()
+        ticks = 2
+        for _ in range(ticks):
+            ms.policy.tick()
+        policy_s = (_time.perf_counter() - t0) / ticks
+        # ranked /dir/lookup latency distribution
+        vids = self.rng.sample(sorted(self.catalog),
+                               min(lookup_samples, len(self.catalog)))
+        lat: list[float] = []
+        for vid in vids:
+            t0 = _time.perf_counter()
+            ms.lookup(vid)
+            lat.append(_time.perf_counter() - t0)
+        lat.sort()
+        p = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))]  # noqa: E731
+        return {
+            "heartbeats_per_second": round(hb_rate, 1),
+            "heartbeat_sweep_seconds": round(hb_elapsed / sweeps, 4),
+            "policy_tick_seconds": round(policy_s, 4),
+            "lookup_p50_seconds": round(p(0.50), 6),
+            "lookup_p99_seconds": round(p(0.99), 6),
+            "lookup_samples": len(lat),
+        }
+
+
+def run_scenario(cluster: SimCluster,
+                 scenario: Optional[list[dict]] = None,
+                 log: Optional[Callable[[str], None]] = None,
+                 with_bench: bool = True) -> dict:
+    """Play a scenario, assert invariants after every wave, measure
+    the master's ceilings. Returns the full JSON-able report; overall
+    success is ``report["ok"]``."""
+    log = log or (lambda s: None)
+    scenario = default_scenario() if scenario is None else scenario
+    profiler.configure(enabled=True)
+    profiler.ensure_started()
+    ms = cluster.ms
+    report: dict = {
+        "seed": cluster.seed,
+        "nodes": len(cluster.nodes),
+        "volumes": len(cluster.catalog),
+        "waves": [],
+        "ok": True,
+    }
+    for spec in scenario:
+        spec = dict(spec)
+        name = spec.pop("wave")
+        if name not in WAVES:
+            raise ValueError(f"unknown wave {name!r}; known: "
+                             f"{', '.join(WAVES)}")
+        log(f"wave {name} {spec or ''}...")
+        t0 = _time.perf_counter()
+        detail = getattr(cluster, f"wave_{name}")(**spec)
+        problems = detail.pop("problems", [])
+        problems += cluster.check_invariants()
+        elapsed = _time.perf_counter() - t0
+        ok = not problems
+        report["waves"].append({
+            "wave": name, "ok": ok, "wall_seconds": round(elapsed, 2),
+            "detail": detail, "problems": problems[:20],
+        })
+        report["ok"] = report["ok"] and ok
+        log(f"wave {name}: {'OK' if ok else 'FAILED'} "
+            f"({elapsed:.1f}s wall"
+            + (f", {len(problems)} problem(s)" if problems else "")
+            + ")")
+        for p in problems[:10]:
+            log(f"  problem: {p}")
+    if with_bench:
+        log("bench: measuring master ceilings...")
+        report["bench"] = cluster.bench()
+        log(f"bench: {report['bench']}")
+    topo = ms.topology
+    report["heartbeats_total"] = topo.heartbeats_total
+    report["heartbeats_unchanged"] = topo.heartbeats_unchanged
+    report["policy_ticks"] = ms.policy.ticks
+    report["policy_actions"] = len(ms.policy.actions)
+    report["jobs"] = ms.jobs.summary()
+    report["churned_total"] = cluster.churned_total
+    report["virtual_seconds"] = round(
+        cluster.clock.time() - 1_700_000_000.0, 1)
+    report["profiler_top"] = [
+        {"stack": s.rsplit(";", 2)[-1], "samples": n}
+        for s, n in profiler.hot_stacks(5)]
+    return report

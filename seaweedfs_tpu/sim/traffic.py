@@ -1,0 +1,83 @@
+"""Deterministic zipfian traffic generation for the sim.
+
+Real object traffic is heavy-tailed: a few volumes take most reads, a
+few tenants issue most requests. :class:`ZipfSampler` gives O(log n)
+rank sampling off a precomputed CDF; :class:`TenantTraffic` composes
+two of them (tenants x hot volumes) into the per-tick load maps the
+sim feeds the telemetry plane and the cumulative payload dicts it
+pushes into the usage plane (the same shape gateways POST to
+``/cluster/usage``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+
+
+class ZipfSampler:
+    """Sample ranks 0..n-1 with P(r) proportional to 1/(r+1)^s."""
+
+    def __init__(self, n: int, s: float = 1.2):
+        if n <= 0:
+            raise ValueError("ZipfSampler needs n >= 1")
+        self.n = n
+        self.s = s
+        acc = 0.0
+        cdf = []
+        for r in range(n):
+            acc += 1.0 / (r + 1) ** s
+            cdf.append(acc)
+        self._cdf = [c / acc for c in cdf]
+
+    def sample(self, rng: random.Random) -> int:
+        return bisect.bisect_left(self._cdf, rng.random())
+
+
+class TenantTraffic:
+    """Zipfian tenants hammering zipfian hot volumes.
+
+    ``tick`` draws ``ops`` (tenant, volume) events and returns the
+    per-volume load map for the telemetry side; ``usage_payload``
+    renders the cumulative per-tenant counters in the JSON shape
+    ``ClusterUsage.ingest`` accepts.
+    """
+
+    def __init__(self, tenants: int, hot_volumes: list[int],
+                 seed: int, s: float = 1.2):
+        self.tenant_names = [f"tenant-{i}" for i in range(tenants)]
+        self.hot_volumes = list(hot_volumes)
+        self.rng = random.Random(seed)
+        self._tenant_z = ZipfSampler(max(1, tenants), s)
+        self._vol_z = ZipfSampler(max(1, len(hot_volumes)), s)
+        #: tenant -> cumulative [requests, bytes_out, errors]
+        self.cum: dict[str, list[int]] = {
+            t: [0, 0, 0] for t in self.tenant_names}
+        self.ops_total = 0
+
+    def tick(self, ops: int) -> dict[int, int]:
+        """Draw ``ops`` events; returns {volume_id: reads}."""
+        loads: dict[int, int] = {}
+        if not self.hot_volumes:
+            return loads
+        for _ in range(ops):
+            t = self.tenant_names[self._tenant_z.sample(self.rng)]
+            vid = self.hot_volumes[self._vol_z.sample(self.rng)]
+            loads[vid] = loads.get(vid, 0) + 1
+            row = self.cum[t]
+            row[0] += 1
+            row[1] += 4096
+        self.ops_total += ops
+        return loads
+
+    def usage_payload(self, component: str = "s3") -> dict:
+        """Cumulative snapshot in the /cluster/usage POST shape."""
+        return {
+            "component": component,
+            "tenants": [
+                {"tenant": t, "bucket": "b0",
+                 "requests": c[0], "bytes_in": 0, "bytes_out": c[1],
+                 "errors": c[2]}
+                for t, c in self.cum.items() if c[0]],
+            "top_keys": [], "topk_total": 0, "topk_capacity": 32,
+        }

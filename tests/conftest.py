@@ -50,6 +50,14 @@ from seaweedfs_tpu.util import lockcheck  # noqa: E402
 lockcheck.install_from_env()
 
 
+def pytest_configure(config):
+    # Tier-1 runs with -m 'not slow'; the slow tier holds the
+    # full-scale simulation acceptance run (minutes of wall time).
+    config.addinivalue_line(
+        "markers", "slow: full-scale runs excluded from tier-1 "
+                   "(select with -m slow)")
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     viols = lockcheck.violations()
     if not viols:

@@ -1,0 +1,246 @@
+"""simweed: the cluster-at-scale simulation harness.
+
+Fast tests drive small SimClusters through the real master's ingestion
+paths; the full-scale acceptance run (2000 nodes / 1M volumes) is
+``@pytest.mark.slow`` and excluded from tier-1.
+"""
+
+import logging
+
+import pytest
+
+from seaweedfs_tpu.cluster.jobs import JobManager, PolicyEngine
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.sim import SimCluster, VirtualClock, run_scenario
+from seaweedfs_tpu.sim.scenario import default_scenario
+from seaweedfs_tpu.sim.traffic import TenantTraffic, ZipfSampler
+from seaweedfs_tpu.util import tracing
+
+@pytest.fixture(autouse=True)
+def _isolate_process_globals():
+    # A SimCluster sweep glogs per reap/policy action, and
+    # run_scenario() turns on the process-global profiler; silence the
+    # former and restore both so later tests see pristine globals.
+    from seaweedfs_tpu.util import profiler
+    logger = logging.getLogger("seaweedfs_tpu")
+    log_level = logger.level
+    prof_enabled = profiler.enabled()
+    logger.setLevel(logging.ERROR)
+    try:
+        yield
+    finally:
+        logger.setLevel(log_level)
+        profiler.configure(enabled=prof_enabled)
+
+
+# ---------------------------------------------------------------- clock
+
+def test_virtual_clock_advances_never_rewinds():
+    c = VirtualClock(start=100.0)
+    assert c.time() == 100.0
+    assert c() == 100.0
+    assert c.advance(5.0) == 105.0
+    with pytest.raises(ValueError):
+        c.advance(-1.0)
+    with pytest.raises(ValueError):
+        c.set(50.0)
+    c.set(200.0)
+    assert c.time() == 200.0
+
+
+# -------------------------------------------------------------- traffic
+
+def test_zipf_traffic_is_deterministic_and_heavy_tailed():
+    a = TenantTraffic(4, list(range(1, 33)), seed=11)
+    b = TenantTraffic(4, list(range(1, 33)), seed=11)
+    la, lb = a.tick(5000), b.tick(5000)
+    assert la == lb                      # same seed, same draws
+    top = max(la.values())
+    assert top > 5000 / 32               # far above uniform share
+    assert sum(la.values()) == 5000
+    payload = a.usage_payload()
+    assert payload["component"] == "s3"
+    assert sum(t["requests"] for t in payload["tenants"]) == 5000
+
+
+def test_zipf_sampler_rejects_empty():
+    with pytest.raises(ValueError):
+        ZipfSampler(0)
+
+
+# ----------------------------------------- heartbeat fast path (spans)
+
+def _heartbeat(port=7701, n_volumes=3, size=100):
+    hb = master_pb2.Heartbeat(ip="sim-hb", port=port,
+                              public_url=f"sim-hb:{port}",
+                              max_volume_count=16)
+    for vid in range(1, n_volumes + 1):
+        hb.volumes.add(id=vid, size=size, file_count=1, version=3)
+    return hb
+
+
+def test_unchanged_heartbeat_allocates_no_span():
+    """The ingestion hot path: only a pulse that actually changes the
+    topology may open a trace span (or format a v-log line)."""
+    from seaweedfs_tpu.cluster.master import MasterServer
+    clock = VirtualClock()
+    ms = MasterServer(clock=clock.time)      # never started: no sockets
+    counter = tracing.METRICS.counter(
+        "spans_total", stage="master.heartbeat.topology", status="ok")
+    was_enabled = tracing._ENABLED
+    tracing.configure(enabled=True)
+    try:
+        # span metrics flush when each trace root closes, so every
+        # pulse gets its own root — exactly the gRPC servicer shape
+        def pulse(hb):
+            with tracing.start_trace("test.heartbeat"):
+                ms.ingest_heartbeat(hb)
+
+        before = counter.value
+        pulse(_heartbeat())                   # new node: changed
+        assert counter.value == before + 1
+        for _ in range(5):                    # steady state
+            pulse(_heartbeat())
+        assert counter.value == before + 1    # no new spans
+        pulse(_heartbeat(size=999))           # stats changed
+        assert counter.value == before + 2
+    finally:
+        tracing.configure(enabled=was_enabled)
+    assert ms.topology.heartbeats_total == 7
+    assert ms.topology.heartbeats_unchanged == 5
+
+
+# ------------------------------------------- policy hysteresis replay
+
+def test_policy_hot_cold_hot_stays_in_hysteresis_band():
+    """Deterministic hot->cold->hot replay: the engine may grow on
+    heat and shrink on cold, but never acts inside the band, never
+    twice within the cooldown dwell."""
+    clock = VirtualClock()
+    jobs = JobManager(clock=clock.time)
+    pol = PolicyEngine(jobs=jobs, clock=clock.time)
+    pol.enabled = True
+
+    replicas = 1
+
+    def row(rate):
+        return [{"volume_id": 1, "collection": "", "size": 10,
+                 "read_only": False, "replicas": replicas,
+                 "placement": "000", "read_rate": rate,
+                 "cache_warmth": 0.0, "is_ec": False, "limit": 1000}]
+
+    # rate profile: climb hot, collapse cold, climb hot again — with
+    # plenty of in-band samples that must produce NO action
+    profile = ([5.0, 20.0, 60.0, 80.0, 80.0, 40.0, 20.0] +
+               [1.0] * 8 + [20.0, 40.0, 70.0, 90.0, 90.0])
+    for rate in profile:
+        clock.advance(15.0)
+        for a in pol.evaluate(row(rate), clock.time()):
+            if a["action"] == "replicate":
+                replicas += 1
+            elif a["action"] == "replica_drop":
+                replicas -= 1
+    acts = list(pol.actions)
+    assert acts, "a hot volume must provoke at least one action"
+    for a in acts:
+        if a["action"] == "replicate":
+            assert a["readRate"] >= pol.hot_read_rate
+        elif a["action"] == "replica_drop":
+            assert a["readRate"] <= pol.cool_read_rate
+        else:
+            pytest.fail(f"unexpected action {a['action']}")
+    # cooldown dwell between consecutive actions on the volume
+    for prev, cur in zip(acts, acts[1:]):
+        assert cur["ts"] - prev["ts"] >= pol.cooldown
+    # the whole replay converges in a handful of actions, not a flap
+    # per sample
+    assert len(acts) <= 4
+    assert 1 <= replicas <= pol.max_replicas
+
+
+# ------------------------------------------------- lease-expiry wave
+
+def test_lease_expiry_wave_500_workers():
+    """500 workers each claim a task and die mid-lease; expiry must
+    re-queue every task away from its dead worker, exactly once."""
+    clock = VirtualClock()
+    jm = JobManager(clock=clock.time, lease_seconds=15.0)
+    n = 500
+    jm.submit("vacuum", range(1, n + 1), submitted_by="test")
+    workers = [f"w{i}:8080" for i in range(n)]
+    claimed = {}
+    for w in workers:
+        t = jm.claim(w)
+        assert t is not None
+        claimed[t["taskId"]] = w
+    assert len(claimed) == n
+    assert jm.claim("late:8080") is None         # everything leased
+    clock.advance(16.0)                          # all leases lapse
+    expired = jm.expire()
+    assert len(expired) == n
+    assert jm.expired_total == n
+    doc = jm.to_map(with_tasks=True)["jobs"][0]
+    assert doc["taskCounts"] == {"pending": n}
+    for t in doc["tasks"]:
+        assert claimed[t["taskId"]] in t["excluded"]
+    # survivors re-claim: never a task whose lease they abandoned
+    for w in workers[:50]:
+        t = jm.claim(w)
+        assert t is not None
+        assert claimed[t["taskId"]] != w
+    assert jm.expire() == []                     # fresh leases hold
+
+
+# ------------------------------------------------------ sim scenarios
+
+def test_sim_cluster_two_wave_scenario_converges():
+    cluster = SimCluster(nodes=24, volumes=720, seed=5,
+                         racks_per_dc=3)
+    report = run_scenario(cluster, [
+        {"wave": "traffic_shift", "hot_ticks": 8, "cool_ticks": 14,
+         "ops": 3000},
+        {"wave": "rack_loss", "outage_ticks": 5, "recovery_ticks": 6},
+    ], with_bench=False)
+    assert report["ok"], [w["problems"] for w in report["waves"]]
+    assert report["heartbeats_unchanged"] > 0
+    assert report["policy_ticks"] > 0
+    rack = next(w for w in report["waves"] if w["wave"] == "rack_loss")
+    assert rack["detail"]["reaped"] == rack["detail"]["killed"] > 0
+
+
+def test_sim_cluster_churn_keeps_indexes_consistent():
+    cluster = SimCluster(nodes=16, volumes=480, seed=9,
+                         racks_per_dc=2)
+    report = run_scenario(cluster, [
+        {"wave": "volume_churn", "fraction": 0.1, "ticks": 5},
+    ], with_bench=False)
+    assert report["ok"], [w["problems"] for w in report["waves"]]
+    assert report["churned_total"] > 0
+    assert cluster.ms.topology.check_indexes() == []
+
+
+def test_default_scenario_rejects_unknown_wave():
+    with pytest.raises(ValueError):
+        default_scenario(["no_such_wave"])
+    with pytest.raises(ValueError):
+        run_scenario(SimCluster(nodes=4, volumes=8, seed=1),
+                     [{"wave": "no_such_wave"}])
+
+
+def test_sim_bench_reports_master_ceilings():
+    cluster = SimCluster(nodes=12, volumes=240, seed=3)
+    b = cluster.bench(lookup_samples=100, sweeps=1)
+    assert b["heartbeats_per_second"] > 0
+    assert b["policy_tick_seconds"] >= 0
+    assert b["lookup_p99_seconds"] >= b["lookup_p50_seconds"] >= 0
+    assert b["lookup_samples"] == 100
+
+
+@pytest.mark.slow
+def test_sim_full_scale_acceptance():
+    """The PR's acceptance run: 2000 nodes, one million volumes, all
+    six waves, every invariant green (minutes of wall time)."""
+    cluster = SimCluster(nodes=2000, volumes=1_000_000, seed=7)
+    report = run_scenario(cluster, default_scenario())
+    assert report["ok"], [w["problems"] for w in report["waves"]]
+    assert report["bench"]["heartbeats_per_second"] > 0
