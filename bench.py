@@ -66,6 +66,7 @@ USAGE_TIMEOUT = 300      # usage-accounting-overhead stage (CPU mini cluster)
 JOBS_TIMEOUT = 300       # maintenance-plane-overhead stage (CPU mini cluster)
 INGRESS_TIMEOUT = 300    # ingress-admission-overhead stage (CPU mini cluster)
 SIM_TIMEOUT = 300        # cluster-at-scale sim stage (in-process master)
+MESH_TIMEOUT = 600       # sharded-mesh encode/rebuild stage (docs/mesh.md)
 SELF = os.path.abspath(__file__)
 REPO = os.path.dirname(SELF)
 ARTIFACTS = os.path.join(REPO, "artifacts")
@@ -270,6 +271,27 @@ def parent() -> None:
     rc, out = _run(["--child-sim"], _scrubbed_env(), SIM_TIMEOUT)
     stage_platforms["sim"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Pod-scale sharded-mesh encode/rebuild (docs/mesh.md): prefers the
+    # real accelerator — the >1.5x mesh-vs-single-device bar applies
+    # there — and falls back to an 8-virtual-device CPU mesh, which is
+    # correctness-gated only (virtual devices share the same cores, so
+    # no speedup is expected or asserted).
+    if platform in ("cpu", None):
+        platform = probe_tpu(attempts=1)
+    mesh_plat = None
+    if platform is not None:
+        rc, out = _run(["--child-mesh"], _ambient_env(), MESH_TIMEOUT)
+        if rc == 0 and _parse_result(out) is not None:
+            mesh_plat = platform
+        else:
+            log(f"--child-mesh failed on {platform} (rc={rc}); "
+                "falling back to a virtual CPU mesh")
+    if mesh_plat is None:
+        rc, out = _run(["--child-mesh"], _scrubbed_env(8), MESH_TIMEOUT)
+        if rc == 0 and _parse_result(out) is not None:
+            mesh_plat = "cpu"
+    stage_platforms["mesh"] = mesh_plat
 
     merged = _read_partials()
     extras = {k: v for k, v in merged.items()
@@ -2014,6 +2036,105 @@ def child_sim() -> None:
     print(json.dumps(res), flush=True)
 
 
+def child_mesh() -> None:
+    """Sharded-mesh encode/rebuild throughput (docs/mesh.md).
+
+    Encodes one synthetic volume through the single-device host path
+    and again through the auto-factored (dp, sp) mesh spanning every
+    local device, then rebuilds a lost-shard set through the same
+    mesh. Any byte difference from the single-device reference fails
+    the stage — a mesh number is only worth persisting for a mesh
+    that writes the reference bytes. The mesh-vs-single ratio is the
+    acceptance bar on real multi-device backends; a virtual CPU mesh
+    (the parent's fallback) shares the same cores, so its ratio is
+    informational only."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from seaweedfs_tpu.parallel import mesh as mesh_mod
+    from seaweedfs_tpu.pipeline import encode, pipe, rebuild
+    from seaweedfs_tpu.pipeline.scheme import EcScheme
+    from seaweedfs_tpu.storage import ec_files, superblock, volume
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        raise SystemExit(
+            "mesh stage: single-device backend — nothing to shard")
+    dp, sp = mesh_mod._auto_factor(n_dev)
+    on_acc = jax.default_backend() in ("tpu", "axon")
+    size = (256 << 20) if on_acc else (16 << 20)
+    scheme = EcScheme(10, 4, large_block_size=1 << 20,
+                      small_block_size=1 << 17)
+    pipe.configure(batch_bytes=8 << 20)
+    work = tempfile.mkdtemp(prefix="bench-mesh-")
+    try:
+        rng = np.random.default_rng(7)
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+
+        def make(name):
+            base = f"{work}/{name}"
+            with open(volume.dat_path(base), "wb") as f:
+                f.write(superblock.SuperBlock().to_bytes())
+                f.write(payload)
+            return base
+
+        def digest(base):
+            h = hashlib.sha256()
+            for i in range(scheme.total_shards):
+                h.update(ec_files.shard_path(base, i).read_bytes())
+            return h.hexdigest()
+
+        single = make("single")
+        t0 = time.perf_counter()
+        encode.write_ec_files(single, scheme)
+        single_dt = time.perf_counter() - t0
+        ref = digest(single)
+
+        meshed = make("mesh")
+        lost = [0, 5, 13]
+        with mesh_mod.scoped(f"{dp},{sp}"):
+            t0 = time.perf_counter()
+            encode.write_ec_files(meshed, scheme)
+            mesh_dt = time.perf_counter() - t0
+            if digest(meshed) != ref:
+                raise SystemExit("mesh stage: mesh shards differ from "
+                                 "the single-device reference")
+            for i in lost:
+                ec_files.shard_path(meshed, i).unlink()
+            t0 = time.perf_counter()
+            done = rebuild.rebuild_ec_files(meshed, scheme)
+            rebuild_dt = time.perf_counter() - t0
+        if sorted(done) != lost or digest(meshed) != ref:
+            raise SystemExit("mesh stage: mesh rebuild diverged from "
+                             "the single-device reference")
+
+        gib = size / (1 << 30)
+        rebuilt_gib = (len(lost) * scheme.shard_file_size(size + 8)
+                       / (1 << 30))
+        res = {
+            "mesh_devices": n_dev,
+            "mesh_dp": dp,
+            "mesh_sp": sp,
+            "mesh_encode_gibps": round(gib / mesh_dt, 3),
+            "mesh_rebuild_gibps": round(rebuilt_gib / rebuild_dt, 3),
+            "mesh_single_encode_gibps": round(gib / single_dt, 3),
+            "mesh_vs_single_ratio": round(single_dt / mesh_dt, 3),
+        }
+        log(f"mesh stage: dp={dp} sp={sp} on {n_dev} devices — encode "
+            f"{res['mesh_encode_gibps']} GiB/s "
+            f"({res['mesh_vs_single_ratio']}x single-device "
+            f"{res['mesh_single_encode_gibps']}), rebuild "
+            f"{res['mesh_rebuild_gibps']} GiB/s")
+        _persist(res)
+        print(json.dumps(res), flush=True)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def probe_child() -> None:
     import jax
     print(jax.devices()[0].platform, flush=True)
@@ -2053,5 +2174,7 @@ if __name__ == "__main__":
         child_ingress_overhead()
     elif "--child-sim" in sys.argv:
         child_sim()
+    elif "--child-mesh" in sys.argv:
+        child_mesh()
     else:
         parent()

@@ -37,6 +37,20 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# Sharded-mesh correctness smoke (docs/mesh.md): encode + rebuild
+# through 2x4 / 1x8 meshes on 8 virtual devices — overlapped,
+# double-buffered, and synchronous — must all be sha256-identical to
+# the single-device reference.
+bash scripts/mesh_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: mesh_smoke failed (exit $rc) — the sharded-mesh" \
+         "encode/rebuild path diverged from the single-device" \
+         "reference; see scripts/mesh_smoke.sh" >&2
+    exit "$rc"
+fi
+
 # Observability-plane smoke (docs/observability.md): SLO burn-rate
 # math, the burn-rate gauges' exposition, a profiler burst, and trace
 # stitching — in-process, a few seconds.

@@ -963,7 +963,17 @@ class JobWorker:
         vol = vs.store.get_volume(vid, col)
         vol.sync()
         self.set_fraction(0.1)
-        encode_mod.encode_volume(vol.base, scheme)
+        mesh_spec = str(params.get("mesh") or "")
+        if mesh_spec:
+            # ec.encode -distributed -mesh dp,sp: the claiming worker
+            # seals its volume over its own device slice. A spec that
+            # cannot tile THIS worker's devices fails the task with the
+            # MeshConfigError text in the job's failure log.
+            from ..parallel import mesh as mesh_mod
+            with mesh_mod.scoped(mesh_spec):
+                encode_mod.encode_volume(vol.base, scheme)
+        else:
+            encode_mod.encode_volume(vol.base, scheme)
         self.set_fraction(0.8)
         vs.store.mount_ec_shards(vid, list(range(scheme.total_shards)),
                                  col)

@@ -1220,6 +1220,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     profiler.ensure_started()
     from ..pipeline import pipe as pipe_mod
     pipe_mod.configure_from(conf)
+    if config_mod.lookup(conf, "mesh") is not None:
+        # parallel/mesh imports jax; a volume server without a [mesh]
+        # section must not pay that at every spawn
+        from ..parallel import mesh as mesh_mod
+        mesh_mod.configure_from(conf)
     jobs_mod.configure_from(conf)
     job_poll = config_mod.lookup(conf, "jobs.poll_seconds")
     store = Store(args.dir, max_volumes=args.max, backend=args.backend,

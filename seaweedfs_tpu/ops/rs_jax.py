@@ -129,6 +129,14 @@ def _donate() -> bool:
                 "ignore", message="Some donated buffers were not usable")
             _donation_warning_squelched = True
     return on
+
+
+def donation_enabled() -> bool:
+    """Public form of the donation knob for the mesh plane
+    (parallel/mesh): sharded apply-only steps donate their freshly
+    device_put input shards under the same policy — and the same
+    CPU-aliasing guard — as the single-device word-form path."""
+    return _donate()
 #: Which Pallas kernel the auto "pallas" variant uses: "transpose"
 #: (default — oracle-smoked on hardware every bench round) or "swar"
 #: (transpose-free; see rs_pallas.apply_gf_matrix_swar). Resolution

@@ -16,12 +16,26 @@ mesh instead:
 Steps are built with shard_map so the collective structure is explicit
 and compiles to XLA collectives; the same code runs on a virtual CPU mesh
 (tests, the driver's dry-run) and a real TPU pod slice.
+
+Production routing (docs/mesh.md): the pipeline's encode/rebuild/batch
+paths call :func:`routing_mesh` — an explicit ``[mesh]`` TOML section or
+``-mesh dp,sp`` shell flag pins a mesh (virtual CPU meshes included, the
+CI recipe), a multi-chip accelerator auto-shards adaptively, and
+everything else stays on the single-device host fast path. The compute
+stage splits into prepare (H2D shard placement — :func:`prepare_batch`)
+and apply (the mesh step — :func:`apply_prepared`) so ``[pipeline]
+double_buffer`` can overlap the next batch's transfer with the current
+batch's collective.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import math
+import threading
+import time
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -60,23 +74,172 @@ def make_mesh(devices=None, dp: Optional[int] = None,
     Without explicit sizes, picks the most-square factorization with the
     stripe axis at least as large as the batch axis (stripe parallelism
     is communication-free here, so over-sharding it is harmless).
+
+    An explicit request is honored or refused, never silently
+    re-factored: any (dp, sp) that cannot tile the device count raises
+    with the factorization that would.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     n = len(devices)
+    if dp is not None and dp < 1 or sp is not None and sp < 1:
+        raise ValueError(f"mesh axes must be positive, got dp={dp} sp={sp}")
     if dp is None and sp is None:
         dp, sp = _auto_factor(n)
     elif dp is None:
         if n % sp:
-            raise ValueError(f"sp={sp} does not divide device count {n}")
+            raise ValueError(
+                f"sp={sp} does not divide device count {n} "
+                f"(auto factorization would be dp,sp = "
+                f"{_auto_factor(n)[0]},{_auto_factor(n)[1]})")
         dp = n // sp
     elif sp is None:
         if n % dp:
-            raise ValueError(f"dp={dp} does not divide device count {n}")
+            raise ValueError(
+                f"dp={dp} does not divide device count {n} "
+                f"(auto factorization would be dp,sp = "
+                f"{_auto_factor(n)[0]},{_auto_factor(n)[1]})")
         sp = n // dp
     if dp * sp != n:
-        raise ValueError(f"dp*sp = {dp}*{sp} != device count {n}")
+        raise ValueError(
+            f"dp*sp = {dp}*{sp} = {dp * sp} != device count {n}: an "
+            f"explicit mesh must tile ALL local devices (want dp*sp == "
+            f"{n}, e.g. {_auto_factor(n)[0]},{_auto_factor(n)[1]})")
     dev_array = np.array(devices).reshape(dp, sp)
     return Mesh(dev_array, axis_names=("dp", "sp"))
+
+
+# --------------------------------------------------------------------------
+# configuration — the [mesh] TOML section / the -mesh shell flag
+# --------------------------------------------------------------------------
+
+class MeshConfigError(ValueError):
+    """A [mesh]/-mesh request that cannot tile the local devices."""
+
+
+@dataclass
+class MeshConfig:
+    """The ``[mesh]`` TOML section (docs/mesh.md): pin an EXPLICIT
+    device mesh for the production encode/rebuild paths. Disabled (the
+    default) keeps the auto routing — multi-chip accelerators shard
+    adaptively, everything else takes the single-device host fast
+    path. ``0`` for an axis means "derive" (most-square
+    factorization). Flags > TOML > defaults, like every other
+    subsystem (util/config.py)."""
+
+    enabled: bool = False
+    dp: int = 0
+    sp: int = 0
+
+
+_CONFIG = MeshConfig()
+
+
+def current() -> MeshConfig:
+    return _CONFIG
+
+
+def configure(**kw) -> None:
+    """Set config fields; None values keep their current setting."""
+    for key, val in kw.items():
+        if not hasattr(_CONFIG, key):
+            raise TypeError(f"unknown mesh config key {key!r}")
+        if val is not None:
+            cur = getattr(_CONFIG, key)
+            setattr(_CONFIG, key, type(cur)(val))
+
+
+def configure_from(conf: dict) -> None:
+    """Apply a loaded TOML dict's ``[mesh]`` block (missing keys keep
+    their current values)."""
+    from ..util import config as config_mod
+    sect = config_mod.lookup(conf, "mesh")
+    if not isinstance(sect, dict):
+        return
+    configure(**{k: sect.get(k) for k in ("enabled", "dp", "sp")})
+
+
+def parse_spec(spec: str) -> tuple[int, int]:
+    """``-mesh dp,sp`` -> (dp, sp); ``-mesh auto`` -> (0, 0), the
+    most-square factorization of the local device count."""
+    text = (spec or "").strip().lower()
+    if text in ("auto", ""):
+        return 0, 0
+    parts = text.split(",")
+    try:
+        if len(parts) != 2:
+            raise ValueError
+        dp, sp = int(parts[0]), int(parts[1])
+        if dp < 1 or sp < 1:
+            raise ValueError
+    except ValueError:
+        raise MeshConfigError(
+            f"bad mesh spec {spec!r}: want 'dp,sp' with positive "
+            f"integers (e.g. '2,4') or 'auto'") from None
+    return dp, sp
+
+
+@contextlib.contextmanager
+def scoped(spec: str):
+    """Enable an explicit mesh for one command/job (the ``-mesh`` shell
+    flag; the ec_encode job param): parse, validate against the local
+    device count — a clear :class:`MeshConfigError` BEFORE any work
+    starts — and restore the previous config on exit. Yields the Mesh."""
+    dp, sp = parse_spec(spec)
+    prev = (_CONFIG.enabled, _CONFIG.dp, _CONFIG.sp)
+    _CONFIG.enabled, _CONFIG.dp, _CONFIG.sp = True, dp, sp
+    try:
+        yield configured_mesh()
+    finally:
+        _CONFIG.enabled, _CONFIG.dp, _CONFIG.sp = prev
+
+
+_configured_cache: dict = {}   # (n_devices, dp, sp) -> Mesh
+
+
+def configured_mesh() -> Optional[Mesh]:
+    """The ``[mesh]``-configured Mesh over all local devices, or None
+    when the section is disabled. An explicit (dp, sp) that cannot tile
+    the device count is a :class:`MeshConfigError` — the request is
+    honored or refused, never silently re-factored."""
+    if not _CONFIG.enabled:
+        return None
+    n = len(jax.devices())
+    key = (n, _CONFIG.dp, _CONFIG.sp)
+    mesh = _configured_cache.get(key)
+    if mesh is None:
+        try:
+            mesh = make_mesh(dp=_CONFIG.dp or None,
+                             sp=_CONFIG.sp or None)
+        except ValueError as e:
+            auto = _auto_factor(n)
+            raise MeshConfigError(
+                f"mesh dp={_CONFIG.dp or 'auto'},"
+                f"sp={_CONFIG.sp or 'auto'} cannot tile the {n} local "
+                f"device(s): {e}. Pass -mesh dp,sp with dp*sp == {n} "
+                f"(e.g. '{auto[0]},{auto[1]}'), or -mesh auto.") from e
+        _configured_cache.clear()  # one live shape; drop stale counts
+        _configured_cache[key] = mesh
+    return mesh
+
+
+#: Sentinel :func:`routing_mesh` returns for "shard, but let the auto
+#: path adapt the mesh per batch" (multi-chip accelerators).
+AUTO = object()
+
+
+def routing_mesh():
+    """What the production twin paths (pipeline encode / rebuild /
+    coalescing batcher) should do: a Mesh when ``[mesh]`` is enabled
+    (virtual CPU meshes included — the CI recipe), the :data:`AUTO`
+    sentinel on a multi-chip accelerator (adaptive dp, Pallas
+    kernels), or None for the single-device host fast path."""
+    mesh = configured_mesh()
+    if mesh is not None:
+        return mesh
+    from ..ops.rs_jax import _use_pallas
+    if _use_pallas() and len(jax.devices()) > 1:
+        return AUTO
+    return None
 
 
 def make_sharded_encode_step(encoder: Encoder, mesh: Mesh):
@@ -182,8 +345,14 @@ def _make_apply_only_step(coefs: np.ndarray, mesh: Mesh):
     psum belongs to the verify-style steps, not to every data batch —
     paying a full reduction plus a both-axes collective per batch would
     be wasted ICI traffic. On an accelerator the per-shard math is the
-    fused Pallas kernel; elsewhere the XLA network."""
-    from ..ops import rs_pallas
+    fused Pallas kernel; elsewhere the XLA network.
+
+    The input shards are donated when the donation knob engages
+    (rs_jax.donation_enabled — real accelerators only): every caller
+    feeds a freshly device_put array that is never reused, so XLA may
+    release the input HBM inside the computation — the same early-free
+    win the single-device word-form path gets from _jitted_apply."""
+    from ..ops import rs_jax, rs_pallas
     if _real_accelerator():
         def step(x):
             return rs_pallas.apply_gf_matrix(coefs, x)
@@ -195,7 +364,8 @@ def _make_apply_only_step(coefs: np.ndarray, mesh: Mesh):
         in_specs=P("dp", None, "sp"),
         out_specs=P("dp", None, "sp"),
     )
-    return jax.jit(mapped)
+    donate = (0,) if rs_jax.donation_enabled() else ()
+    return jax.jit(mapped, donate_argnums=donate)
 
 
 def _real_accelerator() -> bool:
@@ -213,42 +383,122 @@ def _granule(sp: int) -> int:
     return sp * (rs_pallas.SEG_BYTES if _real_accelerator() else GROUP)
 
 
-def _apply_host_sharded(coefs: np.ndarray, batch: np.ndarray):
-    """Apply coefficient rows to a HOST (B, n_in, S) u8 batch over a
-    mesh spanning ALL local devices; returns an async device
-    (B, n_out, S) result (np.asarray materializes it — callers in the
-    3-stage pipeline keep their D2H on the writer thread).
+# --------------------------------------------------------------------------
+# telemetry — pipe.compute split into dispatch (H2D shard placement)
+# vs collective (the mesh step) time, plus per-axis gauges
+# --------------------------------------------------------------------------
 
-    Mesh shape adapts to the batch: small B (the rebuild path streams
-    B=1 chunks) takes an sp-only mesh so every device holds a stripe
-    slice instead of (dp-1)/dp of them computing zero padding. The
-    batch is padded on the row axis to the dp multiple and on S to the
-    kernel granule (zero rows/columns map to zero output, sliced off
-    lazily), then sharded (dp, -, sp) — stripe parallelism needs no
-    communication."""
+_STATS_LOCK = threading.Lock()
+_TOTALS = {"batches": 0, "bytes_in": 0, "bytes_out": 0,
+           "dispatch_seconds": 0.0, "collective_seconds": 0.0}
+_LAST_SHAPE = {"dp": 0, "sp": 0}
+#: the closed stage vocabulary — prepare is "dispatch", the mesh step
+#: is "collective"; nothing else ever reaches _observe
+_STAGE_NAMES = {"dispatch": "pipe.compute.dispatch",
+                "collective": "pipe.compute.collective"}
+#: stage suffix -> (latency histogram, bytes counter); cached like
+#: pipe._STAGE_INSTRUMENTS — a rare double-create just wins the same
+#: registry entry.
+_INSTRUMENTS: dict = {}
+
+
+def _observe(kind: str, seconds: float, nbytes: int, mesh: Mesh) -> None:
+    """Fold one prepare ("dispatch") or step ("collective") measurement
+    into the module totals, the shared ``request_stage_seconds{stage=
+    pipe.compute.<kind>}`` tracing series (the PR 6 pipeline split),
+    and the per-axis ``seaweed_mesh_axis_size`` gauges."""
+    from ..util import tracing
+    tup = _INSTRUMENTS.get(kind)
+    if tup is None:
+        stage = _STAGE_NAMES[kind]
+        tup = (tracing.METRICS.histogram("request_stage_seconds",
+                                         stage=stage),
+               tracing.METRICS.counter("stage_bytes_total",
+                                       stage=stage))
+        _INSTRUMENTS[kind] = tup
+    tup[0].observe(seconds)
+    if nbytes:
+        tup[1].inc(nbytes)
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    shape_changed = False
+    with _STATS_LOCK:
+        _TOTALS[f"{kind}_seconds"] += seconds
+        if kind == "dispatch":
+            _TOTALS["batches"] += 1
+            _TOTALS["bytes_in"] += nbytes
+        else:
+            _TOTALS["bytes_out"] += nbytes
+        if (_LAST_SHAPE["dp"], _LAST_SHAPE["sp"]) != (dp, sp):
+            _LAST_SHAPE["dp"], _LAST_SHAPE["sp"] = dp, sp
+            shape_changed = True
+    if shape_changed:
+        for axis, size in (("dp", dp), ("sp", sp)):
+            tracing.METRICS.gauge("mesh_axis_size", axis=axis).set(size)
+
+
+def debug_payload() -> dict:
+    """``/debug/vars`` "mesh" section (util/varz.py): the configured
+    shape plus the cumulative dispatch/collective split."""
+    with _STATS_LOCK:
+        out = {k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in _TOTALS.items()}
+        out["axes"] = dict(_LAST_SHAPE)
+    out["configured"] = {"enabled": _CONFIG.enabled,
+                         "dp": _CONFIG.dp, "sp": _CONFIG.sp}
+    return out
+
+
+def reset_telemetry() -> None:
+    """Drop the cumulative mesh-stage totals (tests)."""
+    with _STATS_LOCK:
+        for k in _TOTALS:
+            _TOTALS[k] = 0 if isinstance(_TOTALS[k], int) else 0.0
+        _LAST_SHAPE["dp"] = _LAST_SHAPE["sp"] = 0
+
+
+# --------------------------------------------------------------------------
+# the production host-batch path: prepare (H2D) / apply (mesh step)
+# --------------------------------------------------------------------------
+
+class Prepared:
+    """A host batch already placed on the mesh: the (possibly padded)
+    async sharded device array plus the original (b, s) so apply can
+    slice the padding back off lazily."""
+
+    __slots__ = ("arr", "b", "s", "mesh")
+
+    def __init__(self, arr, b: int, s: int, mesh: Mesh):
+        self.arr = arr
+        self.b = b
+        self.s = s
+        self.mesh = mesh
+
+
+def _auto_mesh_for(b: int) -> Mesh:
+    """The adaptive auto mesh: small B (the rebuild path streams B=1
+    chunks) drops to an sp-only mesh so every device holds a stripe
+    slice instead of (dp-1)/dp of them computing zero padding."""
     global _auto_n_devices
     n_dev = len(jax.devices())
     if _auto_n_devices != n_dev:
         _auto_meshes.clear()
         _auto_steps.clear()  # steps bake their mesh into shard_map
         _auto_n_devices = n_dev
-    b, _n_in, s = batch.shape
     dp_auto, _ = _auto_factor(n_dev)
     dp = dp_auto if b >= dp_auto else 1
     mesh = _auto_meshes.get(dp)
     if mesh is None:
         mesh = make_mesh(dp=dp)
         _auto_meshes[dp] = mesh
-    sp = mesh.shape["sp"]
-    gran = _granule(sp)
-    b_pad = -(-b // dp) * dp
-    s_pad = -(-s // gran) * gran
-    if b_pad != b or s_pad != s:
-        padded = np.zeros((b_pad, _n_in, s_pad), dtype=np.uint8)
-        padded[:b, :, :s] = batch
-        batch = padded
-    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
-    key = (dp, sp, coefs.shape, coefs.tobytes())
+    return mesh
+
+
+def _step_for(coefs: np.ndarray, mesh: Mesh):
+    """LRU-cached apply-only step for (mesh shape, coefs). Keyed by
+    shape, not Mesh identity: every mesh here spans all local devices
+    in enumeration order, so equal shapes are interchangeable."""
+    key = (mesh.shape["dp"], mesh.shape["sp"],
+           coefs.shape, coefs.tobytes())
     step = _auto_steps.get(key)
     if step is None:
         step = _make_apply_only_step(coefs, mesh)
@@ -257,39 +507,127 @@ def _apply_host_sharded(coefs: np.ndarray, batch: np.ndarray):
             _auto_steps.popitem(last=False)
     else:
         _auto_steps.move_to_end(key)
-    out = step(shard_batch(batch, mesh))
-    return out[:b, :, :s]  # lazy device slice; no sync here
+    return step
 
 
-def encode_parity_host_sharded(encoder: Encoder, batch: np.ndarray):
+def prepare_batch(batch: np.ndarray, mesh=None) -> Prepared:
+    """Pad a HOST (B, n_in, S) u8 batch to the mesh geometry and start
+    its H2D transfer with (dp, -, sp) NamedSharding.
+
+    Rows pad to the dp multiple and S to the kernel granule — zero
+    rows/columns map to zero output and are sliced off lazily by
+    :func:`apply_prepared`. With ``mesh=None`` (or :data:`AUTO`) the
+    adaptive auto mesh is used; an explicit Mesh is honored AS GIVEN —
+    an uneven batch pads rather than re-factoring the mesh. The
+    placement time lands in the ``pipe.compute.dispatch`` stage, which
+    is what ``[pipeline] double_buffer`` overlaps with the previous
+    batch's collective."""
+    t0 = time.perf_counter()
+    b, n_in, s = batch.shape
+    if mesh is None or mesh is AUTO:
+        mesh = _auto_mesh_for(b)
+    dp = mesh.shape["dp"]
+    sp = mesh.shape["sp"]
+    gran = _granule(sp)
+    b_pad = -(-b // dp) * dp
+    s_pad = -(-s // gran) * gran
+    if b_pad != b or s_pad != s:
+        padded = np.zeros((b_pad, n_in, s_pad), dtype=np.uint8)
+        padded[:b, :, :s] = batch
+        batch = padded
+    arr = shard_batch(batch, mesh)
+    _observe("dispatch", time.perf_counter() - t0, batch.nbytes, mesh)
+    return Prepared(arr, b, s, mesh)
+
+
+def apply_prepared(coefs: np.ndarray, prep: Prepared):
+    """Apply coefficient rows to a prepared (sharded) batch; returns
+    the async device (b, n_out, s) result sliced back to the original
+    extents (np.asarray materializes it — callers in the 3-stage
+    pipeline keep their D2H on the writer thread). The step-enqueue
+    time lands in the ``pipe.compute.collective`` stage."""
+    t0 = time.perf_counter()
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    step = _step_for(coefs, prep.mesh)
+    out = step(prep.arr)[:prep.b, :, :prep.s]  # lazy slice; no sync
+    _observe("collective", time.perf_counter() - t0, out.nbytes,
+             prep.mesh)
+    return out
+
+
+def encode_step_fns(encoder: Encoder, mesh=None):
+    """(prepare_fn, apply_fn) pair for the pipeline's split compute
+    stage (pipe.run_pipeline's ``prepare_fn``): prepare starts the H2D
+    shard placement, apply runs the mesh parity step on the prepared
+    array — the split that lets ``[pipeline] double_buffer`` overlap
+    the next batch's transfer with the current batch's collective."""
+    coefs = encoder.parity_coefs
+
+    def prep(batch: np.ndarray) -> Prepared:
+        return prepare_batch(batch, mesh)
+
+    def apply(prepared: Prepared):
+        return apply_prepared(coefs, prepared)
+
+    return prep, apply
+
+
+def _apply_host_sharded(coefs: np.ndarray, batch: np.ndarray, mesh=None):
+    """Apply coefficient rows to a HOST (B, n_in, S) u8 batch over a
+    mesh spanning ALL local devices; returns an async device
+    (B, n_out, S) result. ``mesh=None``/:data:`AUTO` adapts the mesh
+    to the batch; an explicit Mesh is honored as given (rows pad, the
+    mesh never silently re-factors). The prepare/apply split is the
+    same one the pipeline uses for double buffering."""
+    return apply_prepared(coefs, prepare_batch(batch, mesh))
+
+
+def encode_parity_host_sharded(encoder: Encoder, batch: np.ndarray,
+                               mesh=None):
     """Production multi-chip encode: HOST (B, k, S) u8 -> async
     (B, m, S) parity over all local devices. This is the entry the
-    coalescing batcher uses when more than one device exists (the
-    single-chip tunnel env never takes it; the 8-device CPU mesh in
-    tests and the driver's dryrun do)."""
-    return _apply_host_sharded(encoder.parity_coefs, batch)
+    coalescing batcher uses when routing_mesh() says to shard — the
+    8-device CPU mesh in tests, the driver's dryrun, an explicit
+    [mesh]/-mesh config, and real multi-chip accelerators (the
+    single-chip tunnel env never takes it). ``mesh``: None/AUTO for
+    the adaptive auto mesh, or the explicit Mesh to honor."""
+    return _apply_host_sharded(encoder.parity_coefs, batch, mesh)
 
 
 def reconstruct_host_sharded(encoder: Encoder, survivors: np.ndarray,
-                             present, wanted):
+                             present, wanted, mesh=None):
     """Production multi-chip rebuild: decode rows for (present ->
     wanted) applied to HOST survivor chunks over the whole mesh — the
     multi-device form of reconstruct_batch_host that the rebuild
-    pipeline uses when more than one device exists. ``survivors``:
-    (B, len(present), S) u8, first k used."""
+    pipeline uses when routing_mesh() says to shard. ``survivors``:
+    (B, len(present), S) u8, first k used. ``mesh`` as in
+    :func:`encode_parity_host_sharded`."""
     rows = encoder.decode_matrix_rows(list(present), list(wanted))
     chosen = survivors[:, :encoder.data_shards, :]
     if not chosen.flags.c_contiguous:
         chosen = np.ascontiguousarray(chosen)
-    return _apply_host_sharded(rows, chosen)
+    return _apply_host_sharded(rows, chosen, mesh)
 
 
-def shard_batch(x: np.ndarray, mesh: Mesh):
-    """Device-put a (B, k, S) batch with (dp, -, sp) sharding; validates
-    divisibility (S per chip must stay a multiple of the packing group)."""
+def shard_batch(x: np.ndarray, mesh: Mesh, pad: bool = False):
+    """Device-put a (B, k, S) batch with (dp, -, sp) sharding.
+
+    Validates divisibility (rows must divide dp; S per chip must stay
+    a multiple of the 128-byte packing group) — or, with ``pad=True``,
+    zero-pads the row axis to the dp multiple and S to the sp*group
+    granule instead (zero rows/columns encode to zero output; callers
+    slice by the ORIGINAL extents, as prepare_batch/apply_prepared
+    do)."""
     dp = mesh.shape["dp"]
     sp = mesh.shape["sp"]
-    b, _, s = x.shape
+    b, n_in, s = x.shape
+    if pad and (b % dp or s % (sp * GROUP)):
+        b_pad = -(-b // dp) * dp
+        s_pad = -(-s // (sp * GROUP)) * (sp * GROUP)
+        padded = np.zeros((b_pad, n_in, s_pad), dtype=np.uint8)
+        padded[:b, :, :s] = x
+        x = padded
+        b, s = b_pad, s_pad
     if b % dp:
         raise ValueError(f"batch {b} not divisible by dp={dp}")
     if s % (sp * GROUP):

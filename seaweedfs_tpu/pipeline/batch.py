@@ -183,20 +183,20 @@ def encode_packed(sources: Iterable[tuple[object, np.ndarray]],
 
 
 def _pick_encode_fn(scheme: EcScheme):
-    """Compute stage for the pipeline: on a multi-chip accelerator the
-    coalesced batches dp/sp-shard over the whole mesh
+    """Compute stage for the pipeline: when routing_mesh() says to
+    shard — a multi-chip accelerator, or an explicit [mesh]/-mesh
+    config (virtual CPU meshes included) — the coalesced batches
+    dp/sp-shard over the whole mesh
     (parallel/mesh.encode_parity_host_sharded — the reference spreads
     this work over volume servers; the TPU-native form spreads it over
     chips with one psum of collectives cost). Single-device backends
     keep the zero-relayout host fast path."""
-    import jax
-
-    from ..ops.rs_jax import _use_pallas
-    if _use_pallas() and len(jax.devices()) > 1:
-        from ..parallel import mesh as mesh_mod
+    from ..parallel import mesh as mesh_mod
+    m = mesh_mod.routing_mesh()
+    if m is not None:
         enc = scheme.encoder
         return lambda batch: mesh_mod.encode_parity_host_sharded(
-            enc, batch)
+            enc, batch, mesh=m)
     return scheme.encoder.encode_parity_host
 
 

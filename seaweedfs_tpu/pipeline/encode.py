@@ -180,8 +180,24 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
     datp = _require_local_dat(base)
     dat_size = datp.stat().st_size
     k = scheme.data_shards
-    encode_multi, group, max_batch_bytes = pipe.pick_grouped_dispatch(
-        scheme.encoder.encode_parity_host_multi, max_batch_bytes)
+    from ..parallel import mesh as mesh_mod
+    mesh = mesh_mod.routing_mesh()
+    if mesh is not None:
+        # mesh twin path ([mesh]/-mesh, or a multi-chip accelerator):
+        # every batch dp/sp-shards over the devices. Grouping is a
+        # single-accelerator lever, so it stays off; instead the
+        # compute stage splits into prepare (H2D shard placement) +
+        # apply (the mesh step), which is what [pipeline] double_buffer
+        # overlaps. Identical plans and offsets keep output bytes equal
+        # to the host path (scripts/mesh_smoke.sh asserts it).
+        prepare_fn, encode_fn = mesh_mod.encode_step_fns(
+            scheme.encoder, mesh)
+        encode_multi, group = None, 1
+    else:
+        prepare_fn = None
+        encode_fn = scheme.encoder.encode_parity_host
+        encode_multi, group, max_batch_bytes = pipe.pick_grouped_dispatch(
+            scheme.encoder.encode_parity_host_multi, max_batch_bytes)
 
     plans = list(plan_batches(dat_size, scheme, max_batch_bytes))
     paths = [str(ec_files.shard_path(base, i))
@@ -220,13 +236,22 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
                         view[boff + have:boff + want] = 0
                 yield _BatchMeta(plan, buf), view.reshape(plan.shape)
 
-        def shard_rows(col2d: np.ndarray, row_ok: bool):
+        def shard_rows(col2d: np.ndarray, row_ok: bool,
+                       pooled: bool = False):
             # rows of a (R, block) column view are contiguous even
             # though the view is strided; below ROW_WRITE_MIN_BLOCK the
             # per-row overhead beats the gather-copy it avoids, so tiny
             # blocks flatten first (and stop referencing the source).
             if row_ok:
                 return [col2d[r] for r in range(col2d.shape[0])]
+            if pooled:
+                # the copy path releases the pooled buffer as soon as
+                # the submits return (token=None), so data rows must
+                # NOT view it: for R=1 the column view is already
+                # contiguous and ascontiguousarray would alias the
+                # buffer the reader is about to refill — flatten()
+                # always copies
+                return [col2d.flatten()]
             return [np.ascontiguousarray(col2d).reshape(-1)]
 
         def write_pooled(meta: _BatchMeta, batch, parity):
@@ -244,7 +269,8 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
             try:
                 for s in range(k):
                     writer.submit(paths[s], plan.shard_off,
-                                  shard_rows(batch[:, s], row_ok), token)
+                                  shard_rows(batch[:, s], row_ok,
+                                             pooled=True), token)
                     done += 1
             except writeback.WriterError:
                 # fire the unreached counts so the buffer still
@@ -280,11 +306,11 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         t0 = time.perf_counter()
         try:
             pipe.run_pipeline(
-                batches(), scheme.encoder.encode_parity_host,
+                batches(), encode_fn,
                 write_pooled if writer is not None else write_inline,
                 encode_multi_fn=encode_multi, group=group,
                 recycle_fn=recycle, stats=st, overlapped=overlapped,
-                publish=False)
+                publish=False, prepare_fn=prepare_fn)
         except pipe.PipelineError:
             if writer is not None:
                 writer.abort()

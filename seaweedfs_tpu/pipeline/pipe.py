@@ -89,6 +89,7 @@ class PipelineConfig:
     feedback: bool = True                    # stage-latency controller
     overlapped: bool = True                  # False = synchronous path
     preallocate: bool = True                 # size shard files up front
+    double_buffer: bool = False              # two-deep H2D lookahead
 
 
 _CONFIG = PipelineConfig()
@@ -118,7 +119,7 @@ def configure_from(conf: dict) -> None:
     configure(**{k: sect.get(k) for k in (
         "depth", "batch_bytes", "grouped_batch_bytes", "group_cap",
         "writer_threads", "writer_queue_depth", "pool_buffers",
-        "feedback", "overlapped", "preallocate")})
+        "feedback", "overlapped", "preallocate", "double_buffer")})
 
 
 def pick_grouped_dispatch(multi_fn, max_bytes: int,
@@ -391,7 +392,9 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
                  overlapped: Optional[bool] = None,
                  controller: Optional[GroupController] = None,
                  kind: str = "pipe",
-                 publish: bool = True) -> int:
+                 publish: bool = True,
+                 prepare_fn: Optional[
+                     Callable[[np.ndarray], Any]] = None) -> int:
     """Drive (meta, host_batch) items through encode_fn with full
     read/compute/write overlap.
 
@@ -421,6 +424,18 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
     synchronous reference path the smoke test compares shard bytes
     against.
 
+    ``prepare_fn(batch)``, when given, splits the compute stage in
+    two: its return value (e.g. a mesh-sharded device array — see
+    parallel/mesh.encode_step_fns) is what ``encode_fn`` receives
+    instead of the raw host batch. With ``[pipeline] double_buffer``
+    the overlapped path runs a two-deep lookahead — the NEXT batch's
+    ``prepare_fn`` (its async H2D ``jax.device_put``) is issued before
+    the CURRENT batch's ``encode_fn``, so the transfer overlaps the
+    compute; the synchronous path runs prepare+encode back to back, so
+    output bytes are identical either way (scripts/mesh_smoke.sh
+    asserts it). Mutually exclusive with grouped dispatch — grouping
+    is a single-accelerator lever, the split a mesh one.
+
     ``stats`` (a :class:`PipeStats`) is filled with the per-stage
     breakdown; every run is also folded into the process totals at
     ``/debug/vars`` under ``kind`` unless ``publish`` is False (the
@@ -434,16 +449,25 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
         overlapped = cfg.overlapped
     st = stats if stats is not None else PipeStats()
     grouping = encode_multi_fn is not None and group > 1
+    if grouping and prepare_fn is not None:
+        raise ValueError(
+            "prepare_fn cannot combine with grouped dispatch (grouping "
+            "is single-accelerator only; the prepare/apply split is "
+            "the mesh path)")
     if grouping and controller is None and cfg.feedback:
         controller = GroupController(group)
     t_wall = time.perf_counter()
     try:
         if not overlapped:
-            n = _run_sync(batches, encode_fn, write_fn, recycle_fn, st)
+            n = _run_sync(batches, encode_fn, write_fn, recycle_fn, st,
+                          prepare_fn)
         else:
             n = _run_overlapped(batches, encode_fn, write_fn, depth,
                                 encode_multi_fn if grouping else None,
-                                group, recycle_fn, st, controller)
+                                group, recycle_fn, st, controller,
+                                prepare_fn,
+                                cfg.double_buffer and
+                                prepare_fn is not None)
     finally:
         st.wall_seconds = time.perf_counter() - t_wall
         if publish:
@@ -456,8 +480,11 @@ def _batch_nbytes(batch) -> int:
 
 
 def _run_sync(batches, encode_fn, write_fn, recycle_fn,
-              st: PipeStats) -> int:
-    """The synchronous reference path: same stages, one thread."""
+              st: PipeStats, prepare_fn=None) -> int:
+    """The synchronous reference path: same stages, one thread
+    (prepare runs immediately before encode, so the split changes
+    nothing here — that is what makes it the byte-identity oracle for
+    the double-buffered path)."""
     n = 0
     it = iter(batches)
     while True:
@@ -469,7 +496,8 @@ def _run_sync(batches, encode_fn, write_fn, recycle_fn,
         t1 = time.perf_counter()
         st.read_seconds += t1 - t0
         meta, batch = item
-        result = encode_fn(batch)
+        result = encode_fn(batch if prepare_fn is None
+                           else prepare_fn(batch))
         t2 = time.perf_counter()
         st.dispatch_seconds += t2 - t1
         result_np = np.asarray(result)
@@ -490,7 +518,8 @@ def _run_sync(batches, encode_fn, write_fn, recycle_fn,
 def _run_overlapped(batches, encode_fn, write_fn, depth,
                     encode_multi_fn, group, recycle_fn,
                     st: PipeStats,
-                    controller: Optional[GroupController]) -> int:
+                    controller: Optional[GroupController],
+                    prepare_fn=None, lookahead: bool = False) -> int:
     if encode_multi_fn is not None and group > 1:
         depth = max(depth, group)
     read_q: queue.Queue = queue.Queue(maxsize=depth)
@@ -564,6 +593,24 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
     rt.start()
     wt.start()
     n = 0
+    #: double-buffer lookahead ([pipeline] double_buffer): the one
+    #: (meta, batch, prepared) whose H2D transfer is in flight while
+    #: the previous batch computes; flushed after the loop.
+    pending = None
+
+    def _fail(e: BaseException, drop) -> None:
+        # a compute-stage failure: record it, stop the stages, and
+        # recycle every in-flight batch so a pooled reader blocked on
+        # acquire() can drain to completion
+        errors.append(e)
+        stop.set()
+        if recycle_fn is not None:
+            for meta, batch in drop:
+                try:
+                    recycle_fn(meta, batch)
+                except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                    pass
+
     try:
         ended = False
         while not ended:
@@ -583,19 +630,35 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                 meta, batch = item
                 t0 = time.perf_counter()
                 try:
-                    result = encode_fn(batch)
-                except BaseException as e:  # noqa: BLE001 — see below
-                    # compute failed: recycle the in-flight batch so a
-                    # pooled reader blocked on acquire() can drain, and
-                    # surface through the same PipelineError path as
-                    # reader/writer failures
-                    errors.append(e)
-                    stop.set()
-                    if recycle_fn is not None:
-                        try:
-                            recycle_fn(meta, batch)
-                        except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
-                            pass
+                    payload = batch if prepare_fn is None \
+                        else prepare_fn(batch)
+                except BaseException as e:  # noqa: BLE001 — _fail
+                    drop = [(meta, batch)]
+                    if pending is not None:
+                        drop.append(pending[:2])
+                        pending = None
+                    _fail(e, drop)
+                    break
+                if lookahead:
+                    # two-deep H2D double buffering: the batch just
+                    # prepared has its transfer in flight — dispatch
+                    # compute for the PREVIOUS prepared batch so its
+                    # mesh step overlaps this transfer
+                    pending, prev = (meta, batch, payload), pending
+                    if prev is None:
+                        st.dispatch_seconds += time.perf_counter() - t0
+                        continue
+                    meta, batch, payload = prev
+                try:
+                    result = encode_fn(payload)
+                except BaseException as e:  # noqa: BLE001 — see _fail
+                    # compute failed: surface through the same
+                    # PipelineError path as reader/writer failures
+                    drop = [(meta, batch)]
+                    if pending is not None:
+                        drop.append(pending[:2])
+                        pending = None
+                    _fail(e, drop)
                     break
                 dt = time.perf_counter() - t0
                 st.dispatch_seconds += dt
@@ -655,6 +718,30 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
             for (meta, batch), result in zip(items, results):
                 write_q.put((meta, batch, result, share))
             n += len(items)
+        # flush the double-buffer tail: the last prepared batch has no
+        # successor to overlap with
+        if pending is not None:
+            meta, batch, payload = pending
+            pending = None
+            if stop.is_set():
+                if recycle_fn is not None:
+                    try:
+                        recycle_fn(meta, batch)
+                    except BaseException:  # seaweedlint: disable=SW301 — best-effort recycle on shutdown; first error already recorded
+                        pass
+            else:
+                t0 = time.perf_counter()
+                try:
+                    result = encode_fn(payload)
+                except BaseException as e:  # noqa: BLE001 — see _fail
+                    _fail(e, [(meta, batch)])
+                else:
+                    dt = time.perf_counter() - t0
+                    st.dispatch_seconds += dt
+                    st.groups += 1
+                    st.max_group = max(st.max_group, 1)
+                    write_q.put((meta, batch, result, dt))
+                    n += 1
     finally:
         write_q.put(_END)
         wt.join()

@@ -168,17 +168,17 @@ def _pread_into(fd: int, view: np.ndarray, offset: int) -> None:
 
 
 def _pick_reconstruct_fn(scheme: EcScheme, present, missing):
-    """On a multi-chip accelerator the rebuild chunks shard over the
-    whole mesh (parallel/mesh.reconstruct_host_sharded); single-device
-    backends keep the host fast path — same routing rule as the
-    batcher's encode (pipeline/batch._pick_encode_fn)."""
-    import jax
-
-    from ..ops.rs_jax import _use_pallas
+    """When routing_mesh() says to shard — a multi-chip accelerator,
+    or an explicit [mesh]/-mesh config (virtual CPU meshes included) —
+    the rebuild chunks shard over the whole mesh
+    (parallel/mesh.reconstruct_host_sharded); single-device backends
+    keep the host fast path — same routing rule as the batcher's
+    encode (pipeline/batch._pick_encode_fn)."""
+    from ..parallel import mesh as mesh_mod
     enc = scheme.encoder
-    if _use_pallas() and len(jax.devices()) > 1:
-        from ..parallel import mesh as mesh_mod
+    m = mesh_mod.routing_mesh()
+    if m is not None:
         return lambda chunk: mesh_mod.reconstruct_host_sharded(
-            enc, chunk, present, missing)
+            enc, chunk, present, missing, mesh=m)
     return lambda chunk: enc.reconstruct_batch_host(
         chunk, present, missing)

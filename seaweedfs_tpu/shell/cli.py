@@ -70,6 +70,11 @@ def main(argv: list[str] | None = None) -> int:
         # import only when configured (keeps bare shell startup lean)
         from ..pipeline import pipe as pipe_mod
         pipe_mod.configure_from(conf)
+    if config_mod.lookup(conf, "mesh") is not None:
+        # same deal for [mesh] (parallel/mesh imports jax — only pay
+        # that when a mesh is actually configured)
+        from ..parallel import mesh as mesh_mod
+        mesh_mod.configure_from(conf)
 
     if args.master:
         from . import fs_commands  # noqa: F401 — registers fs.* commands

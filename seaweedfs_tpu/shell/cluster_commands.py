@@ -379,13 +379,32 @@ def cmd_ec_encode(env: ClusterEnv, argv: list[str]) -> None:
                    help="run as a leased job sweep on the workers")
     p.add_argument("-parallel", type=int, default=0,
                    help="with -distributed: max concurrent tasks")
+    p.add_argument("-mesh", default="",
+                   help="with -distributed: each worker encodes its "
+                        "volumes on a dp,sp device mesh (or 'auto'); "
+                        "dp*sp must equal the worker's device count")
     args = p.parse_args(argv)
     vid, col = args.volumeId, args.collection
+    if args.mesh and not args.distributed:
+        raise ShellError(
+            "ec.encode: -mesh composes with -distributed (the mesh "
+            "lives on the worker running the encode; the plain cluster "
+            "path generates shards over gRPC)")
     if args.distributed:
         params = {}
         if args.dataShards and args.parityShards:
             params = {"data_shards": args.dataShards,
                       "parity_shards": args.parityShards}
+        if args.mesh:
+            # syntax check here (cheap, fail fast); the device-count
+            # validation happens on the claiming worker, whose device
+            # inventory is what the spec must tile
+            from ..parallel import mesh as mesh_mod
+            try:
+                mesh_mod.parse_spec(args.mesh)
+            except mesh_mod.MeshConfigError as e:
+                raise ShellError(str(e)) from e
+            params["mesh"] = args.mesh
         doc = env._master_http(
             "/cluster/jobs/submit", method="POST",
             body={"kind": "ec_encode", "collection": col,

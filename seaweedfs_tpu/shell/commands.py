@@ -20,6 +20,7 @@ the reference's either way:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import io
 import shlex
 from dataclasses import dataclass, field
@@ -86,6 +87,23 @@ def _scheme_arg(s: Optional[str], default: EcScheme) -> EcScheme:
                     small_block_size=default.small_block_size)
 
 
+@contextlib.contextmanager
+def _mesh_scope(spec: str):
+    """``-mesh dp,sp`` for ec.encode/ec.rebuild: pin the device mesh
+    for the command's pipeline work (parallel/mesh.scoped — validated
+    against the local device count BEFORE any volume is touched). An
+    empty spec keeps the ambient routing."""
+    if not spec:
+        yield None
+        return
+    from ..parallel import mesh as mesh_mod
+    try:
+        with mesh_mod.scoped(spec) as m:
+            yield m
+    except mesh_mod.MeshConfigError as e:
+        raise ShellError(str(e)) from e
+
+
 def _ec_bases(env: CommandEnv) -> list[tuple[str, int, Path]]:
     """Every (collection, vid, base) with EC artifacts in any location."""
     out = []
@@ -106,6 +124,9 @@ def cmd_ec_encode(env: CommandEnv, argv: list[str]) -> None:
     p.add_argument("-collection", default="")
     p.add_argument("-scheme", default="")
     p.add_argument("-keepSource", action="store_true")
+    p.add_argument("-mesh", default="",
+                   help="encode on a dp,sp device mesh (or 'auto'); "
+                        "dp*sp must equal the local device count")
     args = p.parse_args(argv)
     scheme = _scheme_arg(args.scheme, env.scheme)
     store = env.store
@@ -124,8 +145,10 @@ def cmd_ec_encode(env: CommandEnv, argv: list[str]) -> None:
         if base is None:
             raise ShellError(f"volume {args.volumeId} not found")
         replication = ""
-    vi = encode_mod.encode_volume(base, scheme, replication=replication,
-                                  remove_source=False)
+    with _mesh_scope(args.mesh):
+        vi = encode_mod.encode_volume(base, scheme,
+                                      replication=replication,
+                                      remove_source=False)
     if not args.keepSource:
         if vol is not None:
             store.delete_volume(args.volumeId, args.collection)
@@ -175,6 +198,9 @@ def cmd_ec_rebuild(env: CommandEnv, argv: list[str]) -> None:
     p.add_argument("-volumeId", type=int, default=0)
     p.add_argument("-collection", default="")
     p.add_argument("-scheme", default="")
+    p.add_argument("-mesh", default="",
+                   help="rebuild on a dp,sp device mesh (or 'auto'); "
+                        "dp*sp must equal the local device count")
     args = p.parse_args(argv)
     scheme = _scheme_arg(args.scheme, env.scheme)
     store = env.store
@@ -183,13 +209,14 @@ def cmd_ec_rebuild(env: CommandEnv, argv: list[str]) -> None:
         targets.append((args.collection, args.volumeId))
     else:
         targets = sorted({(col, vid) for col, vid, _ in _ec_bases(env)})
-    for col, vid in targets:
-        base = store.gather_ec_volume(vid, col)
-        rebuilt = rebuild_mod.rebuild_ec_files(base, scheme)
-        if rebuilt:
-            store.mount_ec_shards(vid, rebuilt, col)
-        env.println(f"ec.rebuild volume {vid}: "
-                    f"rebuilt {rebuilt if rebuilt else 'nothing'}")
+    with _mesh_scope(args.mesh):
+        for col, vid in targets:
+            base = store.gather_ec_volume(vid, col)
+            rebuilt = rebuild_mod.rebuild_ec_files(base, scheme)
+            if rebuilt:
+                store.mount_ec_shards(vid, rebuilt, col)
+            env.println(f"ec.rebuild volume {vid}: "
+                        f"rebuilt {rebuilt if rebuilt else 'nothing'}")
 
 
 @command("ec.balance")
@@ -434,7 +461,19 @@ def cmd_pipeline_status(env: CommandEnv, argv: list[str]) -> None:
         f"group_cap={cfg.group_cap or 'env'} "
         f"writers={cfg.writer_threads}x{cfg.writer_queue_depth} "
         f"feedback={cfg.feedback} overlapped={cfg.overlapped} "
-        f"preallocate={cfg.preallocate}")
+        f"preallocate={cfg.preallocate} "
+        f"double_buffer={cfg.double_buffer}")
+    import sys as _sys
+    mesh_mod = _sys.modules.get("seaweedfs_tpu.parallel.mesh")
+    if mesh_mod is not None:
+        mp = mesh_mod.debug_payload()
+        if mp["batches"] or mp["configured"]["enabled"]:
+            env.println(
+                f"  mesh: axes=dp{mp['axes']['dp']}xsp{mp['axes']['sp']}"
+                f" batches={mp['batches']} in={mp['bytes_in']}B "
+                f"dispatch={mp['dispatch_seconds']}s "
+                f"collective={mp['collective_seconds']}s "
+                f"configured={mp['configured']}")
     pay = pipe.debug_payload()
     env.println(
         f"  totals: runs={pay['runs']} batches={pay['batches']} "

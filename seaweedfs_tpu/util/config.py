@@ -152,6 +152,18 @@ pool_buffers = 0                 # reusable host buffers; 0 = derive
 feedback = true                  # latency-fed group-size controller
 overlapped = true                # false = synchronous reference path
 preallocate = true               # size shard files up front
+double_buffer = false            # two-deep H2D lookahead (mesh path)
+""",
+    "mesh": """\
+# mesh.toml — explicit (dp, sp) device mesh for EC compute (docs/mesh.md).
+# Disabled: multi-chip accelerators auto-shard, everything else takes
+# the single-device host path. Enabled: encode/rebuild/batch shard over
+# ALL local devices; dp*sp must equal the device count (0 = derive the
+# most-square factorization). The -mesh shell flag overrides per command.
+[mesh]
+enabled = false
+dp = 0                           # volume/batch axis; 0 = derive
+sp = 0                           # stripe (byte-range) axis; 0 = derive
 """,
     "profiler": """\
 # profiler.toml — continuous sampling profiler (docs/observability.md).

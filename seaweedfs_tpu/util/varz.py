@@ -37,6 +37,14 @@ def _pipeline_payload() -> dict:
     return mod.debug_payload()
 
 
+def _mesh_payload() -> dict:
+    # lazy like the pipeline payload: parallel/mesh pulls in jax
+    mod = sys.modules.get("seaweedfs_tpu.parallel.mesh")
+    if mod is None:
+        return {}
+    return mod.debug_payload()
+
+
 def _ingress_payload() -> dict:
     # lazy for the same reason — and httpserver imports stats only,
     # so this stays cheap even when no IngressHTTPServer exists
@@ -80,6 +88,7 @@ def payload(component: str, metrics: Optional[Metrics] = None,
         "faults": faults.debug_payload(),
         "profiler": profiler.debug_payload(),
         "pipeline": _pipeline_payload(),
+        "mesh": _mesh_payload(),
         "ingress": _ingress_payload(),
         "http_pool": retry.pool().payload(),
     }
