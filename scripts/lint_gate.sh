@@ -14,20 +14,29 @@
 set -u
 cd "$(dirname "$0")/.." || exit 2
 
-env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.analysis --gate warning
+# --fail-stale keeps the baseline honest (fixed findings must be
+# pruned, not silently carried); --budget-seconds asserts the whole
+# analysis — interprocedural dataflow included — stays CI-cheap.
+env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.analysis \
+    --gate warning --fail-stale --stats --budget-seconds 30
 rc=$?
 if [ "$rc" -ne 0 ]; then
     echo >&2
     echo "lint_gate: NEW analyzer findings above (exit $rc)." >&2
     echo "lint_gate: fix them, pragma them with a reason, or" \
-         "re-baseline with scripts/seaweedlint --write-baseline" >&2
+         "re-baseline with scripts/seaweedlint --write-baseline;" \
+         "stale entries: scripts/seaweedlint --prune-baseline" >&2
     exit "$rc"
 fi
 
 # Overlapped-ingest correctness smoke (docs/pipeline.md): the pipeline
 # must produce byte-identical shards to the synchronous path. A small
 # volume keeps this under a few seconds while still spanning batches.
-bash scripts/pipeline_smoke.sh $((8 * 1024 * 1024))
+# SEAWEED_BUFCHECK arms the runtime pooled-buffer checker
+# (util/bufcheck.py): recycled slabs are poisoned and every positioned
+# write re-verifies its source generation, so a pooled view consumed
+# after recycle (the PR 12 race class) fails here deterministically.
+SEAWEED_BUFCHECK=1 bash scripts/pipeline_smoke.sh $((8 * 1024 * 1024))
 rc=$?
 if [ "$rc" -ne 0 ]; then
     echo >&2

@@ -2,10 +2,13 @@
 
     python -m seaweedfs_tpu.analysis [paths...]
         [--baseline FILE | --no-baseline] [--write-baseline]
-        [--gate error|warning|none] [--json] [--verbose]
+        [--prune-baseline] [--fail-stale]
+        [--gate error|warning|none] [--format human|json|sarif]
+        [--stats] [--budget-seconds S] [--verbose]
 
 Exit codes: 0 clean (or all findings baselined), 1 new findings at or
-above the gate severity, 2 bad invocation.
+above the gate severity (or stale baseline under --fail-stale, or
+runtime over --budget-seconds), 2 bad invocation.
 """
 
 from __future__ import annotations
@@ -13,11 +16,13 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
-from .baseline import diff_baseline, load_baseline, write_baseline
+from .baseline import (diff_baseline, load_baseline, prune_baseline,
+                       write_baseline)
 from .engine import analyze_paths
-from .findings import SEVERITIES, Finding
+from .findings import SEVERITIES, Finding, to_sarif
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 _DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -29,6 +34,15 @@ def _summarize(findings: list[Finding]) -> str:
         by[f.severity] += 1
     return (f"{len(findings)} finding(s): {by['error']} error, "
             f"{by['warning']} warning, {by['info']} info")
+
+
+def _print_stats(timings: dict[str, float], total: float) -> None:
+    print("seaweedlint --stats: per-rule-family wall time")
+    width = max(len(k) for k in timings) if timings else 10
+    for label, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+        share = 100.0 * secs / total if total else 0.0
+        print(f"  {label:<{width}}  {secs:7.3f}s  {share:5.1f}%")
+    print(f"  {'total':<{width}}  {total:7.3f}s")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -45,19 +59,37 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current findings "
                          "(preserves justifications)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries whose fingerprints no "
+                         "longer match any finding, keep the rest")
+    ap.add_argument("--fail-stale", action="store_true",
+                    help="exit non-zero when stale baseline entries "
+                         "remain (CI mode)")
     ap.add_argument("--gate", choices=["error", "warning", "none"],
                     default="warning",
                     help="fail on new findings at/above this severity "
                          "(default: warning)")
+    ap.add_argument("--format", choices=["human", "json", "sarif"],
+                    default=None, dest="fmt",
+                    help="output format (default: human)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+                    help="alias for --format=json")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule-family wall time")
+    ap.add_argument("--budget-seconds", type=float, default=0.0,
+                    help="fail if the analysis run exceeds this many "
+                         "seconds (0 = no budget)")
     ap.add_argument("--verbose", action="store_true",
                     help="also print info-level findings")
     args = ap.parse_args(argv)
 
+    fmt = args.fmt or ("json" if args.as_json else "human")
     root = _REPO_ROOT
     paths = args.paths or ["seaweedfs_tpu"]
-    findings = analyze_paths(paths, root)
+    timings: dict[str, float] = {}
+    t0 = time.perf_counter()
+    findings = analyze_paths(paths, root, timings)
+    elapsed = time.perf_counter() - t0
 
     baseline_path = args.baseline or _DEFAULT_BASELINE
     if args.write_baseline:
@@ -65,6 +97,17 @@ def main(argv: list[str] | None = None) -> int:
         gated = [f for f in findings if f.severity != "info"]
         write_baseline(baseline_path, gated, prev)
         print(f"wrote {len(gated)} finding(s) to {baseline_path}")
+        return 0
+    if args.prune_baseline:
+        pruned = prune_baseline(
+            baseline_path, [f for f in findings
+                            if f.severity != "info"])
+        print(f"pruned {len(pruned)} stale entr"
+              f"{'y' if len(pruned) == 1 else 'ies'} from "
+              f"{baseline_path}")
+        for e in pruned:
+            print(f"  - {e['rule']} {e['path']}:{e.get('line', '?')} "
+                  f"{e['fingerprint']}")
         return 0
 
     if args.no_baseline:
@@ -82,24 +125,41 @@ def main(argv: list[str] | None = None) -> int:
     shown = [f for f in new
              if args.verbose or f.severity != "info"]
 
-    if args.as_json:
+    over_budget = args.budget_seconds > 0 and \
+        elapsed > args.budget_seconds
+    stale_fail = args.fail_stale and bool(stale)
+
+    if fmt == "json":
         print(json.dumps({
             "findings": [f.to_json() for f in shown],
             "gating": len(gating),
             "stale_baseline": stale,
             "summary": _summarize(findings),
+            "elapsed_seconds": round(elapsed, 3),
         }, indent=1))
+    elif fmt == "sarif":
+        print(json.dumps(to_sarif(shown), indent=1))
     else:
         for f in shown:
             print(f.format())
         if stale:
             print(f"note: {len(stale)} baseline entr"
                   f"{'y is' if len(stale) == 1 else 'ies are'} stale "
-                  f"(fixed) — run --write-baseline to prune")
+                  f"(fixed) — run --prune-baseline to drop them")
         print(f"seaweedlint: {_summarize(findings)}; "
               f"{len(gating)} new at gate severity "
               f"'{args.gate}'")
-    return 1 if gating else 0
+    if args.stats:
+        _print_stats(timings, elapsed)
+    if over_budget:
+        print(f"seaweedlint: runtime budget exceeded: {elapsed:.1f}s "
+              f"> {args.budget_seconds:.1f}s", file=sys.stderr)
+    if stale_fail:
+        print(f"seaweedlint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (--fail-stale); "
+              f"run scripts/seaweedlint --prune-baseline",
+              file=sys.stderr)
+    return 1 if (gating or over_budget or stale_fail) else 0
 
 
 if __name__ == "__main__":

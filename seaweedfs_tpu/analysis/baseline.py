@@ -51,3 +51,17 @@ def diff_baseline(findings: list[Finding], baseline: dict
     stale = [e for e in baseline.get("findings", [])
              if e["fingerprint"] not in current]
     return new, stale
+
+
+def prune_baseline(path: Path, findings: list[Finding]) -> list[dict]:
+    """Drop baseline entries whose fingerprints match no current
+    finding (``seaweedlint --prune-baseline``); justifications on
+    surviving entries are untouched. Returns the pruned entries."""
+    baseline = load_baseline(path)
+    _new, stale = diff_baseline(findings, baseline)
+    if stale:
+        dead = {e["fingerprint"] for e in stale}
+        baseline["findings"] = [e for e in baseline["findings"]
+                                if e["fingerprint"] not in dead]
+        path.write_text(json.dumps(baseline, indent=1) + "\n")
+    return stale

@@ -1,14 +1,24 @@
-"""Analysis driver: walk files -> per-module models -> findings."""
+"""Analysis driver: walk files -> per-module models -> findings.
+
+Pass an empty dict as ``timings`` to either analyze_* entry point to
+get per-rule-family wall time back (the ``--stats`` report and the
+lint_gate runtime budget both read it).
+"""
 
 from __future__ import annotations
 
 import ast
+import time
 from pathlib import Path
 
+from .buffer_rules import check_buffers
+from .dataflow import build_flows
 from .findings import Finding, fingerprint_findings, is_suppressed
+from .jax_rules import check_jax
 from .local_rules import check_local
-from .lockgraph import analyze_locks
+from .lockgraph import Project, analyze_locks
 from .model import ModuleInfo, collect_module
+from .net_rules import check_net
 
 #: Generated / vendored files the rules should not police.
 _EXCLUDE_PARTS = {"__pycache__"}
@@ -46,35 +56,66 @@ def module_name_for(path: Path, root: Path) -> str:
 
 
 def analyze_sources(sources: dict[str, str],
-                    module_names: dict[str, str] | None = None
+                    module_names: dict[str, str] | None = None,
+                    timings: dict[str, float] | None = None
                     ) -> list[Finding]:
     """Analyze {repo-relative path: source text}. The unit the tests
     drive: no filesystem involved."""
+    t = timings if timings is not None else {}
+
+    def timed(label, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        t[label] = t.get(label, 0.0) + (time.perf_counter() - t0)
+        return out
+
     modules: dict[str, ModuleInfo] = {}
     findings: list[Finding] = []
-    for path, src in sorted(sources.items()):
-        name = (module_names or {}).get(path) or \
-            path[:-3].replace("/", ".")
-        try:
-            modules[name] = collect_module(name, path, src)
-        except SyntaxError as e:
-            findings.append(Finding(
-                "SW001", "error", path, e.lineno or 1, f"{name}:<module>",
-                f"syntax error: {e.msg}"))
-    for mi in modules.values():
-        findings.extend(check_local(mi))
-    findings.extend(analyze_locks(modules))
 
-    findings = [
-        f for f in findings
-        if not is_suppressed(f, sources,
-                             tuple(f.extra.get("anchors", ())))]
-    fingerprint_findings(findings, sources)
-    findings.sort(key=Finding.sort_key)
-    return findings
+    def parse():
+        for path, src in sorted(sources.items()):
+            name = (module_names or {}).get(path) or \
+                path[:-3].replace("/", ".")
+            try:
+                modules[name] = collect_module(name, path, src)
+            except SyntaxError as e:
+                findings.append(Finding(
+                    "SW001", "error", path, e.lineno or 1,
+                    f"{name}:<module>", f"syntax error: {e.msg}"))
+
+    timed("parse+model", parse)
+
+    def local():
+        out = []
+        for mi in modules.values():
+            out.extend(check_local(mi))
+        return out
+
+    findings.extend(timed("SW2xx-SW4xx local", local))
+
+    proj = timed("callgraph", lambda: Project(modules))
+    findings.extend(timed("SW1xx lockgraph",
+                          lambda: analyze_locks(modules, proj)))
+    fp = timed("dataflow fixpoint", lambda: build_flows(modules, proj))
+    findings.extend(timed("SW5xx buffer", lambda: check_buffers(fp)))
+    findings.extend(timed("SW6xx net", lambda: check_net(fp, sources)))
+    findings.extend(timed("SW7xx jax", lambda: check_jax(modules)))
+
+    def finish():
+        kept = [
+            f for f in findings
+            if not is_suppressed(f, sources,
+                                 tuple(f.extra.get("anchors", ())))]
+        fingerprint_findings(kept, sources)
+        kept.sort(key=Finding.sort_key)
+        return kept
+
+    return timed("suppress+fingerprint", finish)
 
 
-def analyze_paths(paths: list[str], root: Path) -> list[Finding]:
+def analyze_paths(paths: list[str], root: Path,
+                  timings: dict[str, float] | None = None
+                  ) -> list[Finding]:
     files = discover_files(paths, root)
     sources: dict[str, str] = {}
     names: dict[str, str] = {}
@@ -86,7 +127,7 @@ def analyze_paths(paths: list[str], root: Path) -> list[Finding]:
         sources[rel] = f.read_text(encoding="utf-8",
                                    errors="replace")
         names[rel] = module_name_for(f, root)
-    return analyze_sources(sources, names)
+    return analyze_sources(sources, names, timings)
 
 
 def parse_ok(source: str) -> bool:

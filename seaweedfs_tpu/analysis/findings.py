@@ -60,6 +60,52 @@ def fingerprint_findings(findings: list[Finding],
         f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
 
 
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def to_sarif(findings: list[Finding], tool_version: str = "2") -> dict:
+    """SARIF 2.1.0 document for CI/editor consumption
+    (``seaweedlint --format=sarif``)."""
+    rules: dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rules.setdefault(f.rule, {
+            "id": f.rule,
+            "defaultConfiguration": {
+                "level": _SARIF_LEVELS.get(f.severity, "note")},
+        })
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVELS.get(f.severity, "note"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.qualname}],
+            }],
+            "partialFingerprints": {
+                "seaweedlint/v1": f.fingerprint},
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "seaweedlint",
+                "version": tool_version,
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": sorted(rules.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
+
+
 def suppressed_rules(source_line: str) -> set[str]:
     """Rules disabled by an inline pragma on this source line.
 

@@ -1,0 +1,233 @@
+"""SW7xx — JAX dispatch hazards (lexical pass).
+
+The mesh/ops layers keep jitted step builders at module scope behind
+caches (``_auto_steps``, ``functools.lru_cache``) precisely because a
+``jax.jit``/``shard_map`` constructed inside a pipeline loop retraces
+and recompiles every iteration. These rules police dispatch shape:
+
+- SW701 (warning): ``jax.jit`` / ``pjit`` / ``shard_map`` invoked
+  lexically inside a for/while loop or comprehension — a
+  per-iteration retrace/recompile storm; hoist the jitted callable or
+  cache it (parallel/mesh.py's ``_auto_steps`` pattern).
+- SW702 (warning): ``jax.device_put`` inside a loop — per-batch H2D
+  serializes transfer behind compute; use the pipeline's
+  double-buffered prepare path or donation instead.
+- SW703 (error): a call of a jitted function passes an unhashable
+  literal (list/dict/set/comprehension) at a ``static_argnums``
+  position (or a ``static_argnames`` keyword) — TypeError at trace
+  time, or a silent cache miss per call if __eq__-abused.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .dataflow import _dotted
+from .findings import Finding
+from .model import ModuleInfo
+
+_JIT_LEAVES = {"jit", "pjit"}
+_SHARD_LEAVES = {"shard_map"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+_SHARD_NAME_RE = re.compile(r"^_?shard_map$")
+
+
+def _jax_call_kind(c: ast.Call, mi: ModuleInfo) -> Optional[str]:
+    """-> 'jit' | 'shard_map' | 'device_put' | None."""
+    d = _dotted(c.func)
+    if not d:
+        return None
+    leaf = d.rsplit(".", 1)[-1]
+    root = d.split(".")[0]
+    root_mod = mi.imports.get(root, root)
+    from_jax = root_mod.startswith("jax")
+    if leaf in _JIT_LEAVES and (from_jax or d == leaf):
+        src = mi.from_imports.get(leaf, ("", ""))[0]
+        if "." in d or src.startswith("jax") or from_jax:
+            return "jit"
+    if (_SHARD_NAME_RE.match(leaf) or leaf in _SHARD_LEAVES) and (
+            from_jax or "." not in d):
+        src = mi.from_imports.get(d, ("", ""))[0]
+        if "." in d and not from_jax:
+            return None
+        if "." in d or src.startswith("jax") or _SHARD_NAME_RE.match(d):
+            return "shard_map"
+    if leaf == "device_put" and (from_jax or d == leaf):
+        return "device_put"
+    return None
+
+
+def _static_spec(c: ast.Call) -> tuple[tuple, tuple]:
+    """-> (static positions, static names) parsed from literals."""
+    nums: tuple = ()
+    names: tuple = ()
+    for kw in c.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = (v.value,)
+            elif isinstance(v, ast.Tuple) and all(
+                    isinstance(el, ast.Constant) for el in v.elts):
+                nums = tuple(el.value for el in v.elts)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(el, ast.Constant) for el in v.elts):
+                names = tuple(el.value for el in v.elts)
+    return nums, names
+
+
+class _Scope(ast.NodeVisitor):
+    """One function (or module) scope: loop depth + jit tracking."""
+
+    def __init__(self, mi: ModuleInfo, path: str, qualname: str,
+                 findings: list):
+        self.mi = mi
+        self.path = path
+        self.qualname = qualname
+        self.findings = findings
+        self.loop_depth = 0
+        #: name -> (static positions, static names, jit line)
+        self.jitted: dict[str, tuple] = {}
+
+    # -- nested scopes are walked separately --
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _loop(self, node, parts):
+        self.loop_depth += 1
+        for name in parts:
+            for ch in getattr(node, name, []) or []:
+                self.visit(ch)
+        self.loop_depth -= 1
+
+    def visit_For(self, node):  # noqa: N802
+        self.visit(node.iter)
+        self._loop(node, ("body",))
+        for ch in node.orelse:
+            self.visit(ch)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node):  # noqa: N802
+        self.visit(node.test)
+        self._loop(node, ("body",))
+        for ch in node.orelse:
+            self.visit(ch)
+
+    def _comp(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_ListComp = _comp
+    visit_SetComp = _comp
+    visit_DictComp = _comp
+    visit_GeneratorExp = _comp
+
+    def visit_Assign(self, node):  # noqa: N802
+        if isinstance(node.value, ast.Call) and \
+                _jax_call_kind(node.value, self.mi) == "jit":
+            nums, names = _static_spec(node.value)
+            if nums or names:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jitted[t.id] = (nums, names,
+                                             node.value.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        kind = _jax_call_kind(node, self.mi)
+        if kind in ("jit", "shard_map") and self.loop_depth > 0:
+            fn = "jax.jit" if kind == "jit" else "shard_map"
+            self.findings.append(Finding(
+                "SW701", "warning", self.path, node.lineno,
+                self.qualname,
+                f"{fn}(...) constructed inside a loop — retraces and "
+                f"recompiles every iteration (recompile storm); hoist "
+                f"it or cache the jitted callable (see "
+                f"parallel/mesh.py _auto_steps)"))
+        elif kind == "device_put" and self.loop_depth > 0:
+            self.findings.append(Finding(
+                "SW702", "warning", self.path, node.lineno,
+                self.qualname,
+                "jax.device_put inside a loop serializes per-batch "
+                "H2D behind compute — use the double-buffered "
+                "prepare path (pipeline double_buffer) or donation "
+                "instead of a fresh transfer per iteration"))
+        if kind == "jit":
+            self._check_inline_static(node)
+        self._check_jitted_call(node)
+        self.generic_visit(node)
+
+    def _flag_703(self, line, what):
+        self.findings.append(Finding(
+            "SW703", "error", self.path, line, self.qualname,
+            f"unhashable argument ({what}) passed at a static_argnums/"
+            f"static_argnames position of a jitted function — static "
+            f"args must be hashable (TypeError at trace time)"))
+
+    def _check_static_args(self, call: ast.Call, nums, names):
+        for i in nums:
+            if isinstance(i, int) and 0 <= i < len(call.args) and \
+                    isinstance(call.args[i], _UNHASHABLE):
+                self._flag_703(call.args[i].lineno,
+                               f"positional arg {i}")
+        for kw in call.keywords:
+            if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                self._flag_703(kw.value.lineno, f"keyword {kw.arg!r}")
+
+    def _check_inline_static(self, jit_call: ast.Call):
+        # jax.jit(f, static_argnums=...)([...]) — direct dispatch;
+        # the parent Call tagged the jit call before traversal reached
+        # it, so this fires exactly once
+        parent = getattr(jit_call, "_sw_parent_call", None)
+        if parent is not None:
+            nums, names = _static_spec(jit_call)
+            if nums or names:
+                self._check_static_args(parent, nums, names)
+
+    def _check_jitted_call(self, node: ast.Call):
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in self.jitted:
+            nums, names, _ = self.jitted[node.func.id]
+            self._check_static_args(node, nums, names)
+        if isinstance(node.func, ast.Call):
+            # generic_visit will reach node.func exactly once
+            node.func._sw_parent_call = node
+
+
+def check_jax(modules: dict[str, ModuleInfo]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mi in modules.values():
+        scopes: list[tuple] = [(mi.tree, f"{mi.name}:<module>")]
+
+        def walk(n, cls):
+            for ch in ast.iter_child_nodes(n):
+                if isinstance(ch, ast.ClassDef):
+                    walk(ch, cls if cls is not None else ch.name)
+                elif isinstance(ch, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = (f"{mi.name}:{cls}.{ch.name}" if cls
+                            else f"{mi.name}:{ch.name}")
+                    scopes.append((ch, qual))
+                    walk(ch, cls)
+                else:
+                    walk(ch, cls)
+
+        walk(mi.tree, None)
+        for node, qual in scopes:
+            sc = _Scope(mi, mi.path, qual, findings)
+            for st in node.body:
+                sc.visit(st)
+    return findings

@@ -63,9 +63,13 @@ class Project:
         return self.lock_kinds.get(lock_id, "unknown")
 
 
-def _resolve_call(proj: Project, mi: ModuleInfo, caller: FuncInfo,
-                  ref: tuple) -> Optional[str]:
-    """CallRef -> FuncInfo key, or None when it leaves the project."""
+def resolve_call(proj: Project, mi: ModuleInfo, caller: FuncInfo,
+                 ref: tuple) -> Optional[str]:
+    """CallRef -> FuncInfo key, or None when it leaves the project.
+
+    Shared with the value-flow engine (dataflow.py): both layers
+    resolve call sites against the same project call graph.
+    """
     if ref[0] == "self":
         cls = caller.key.rsplit(":", 1)[1].split(".")[0] \
             if "." in caller.key.rsplit(":", 1)[1] else None
@@ -127,7 +131,7 @@ def _fixpoint(proj: Project):
         resolved[key] = [
             (callee, line, held, wlines)
             for ref, line, held, wlines in f.calls
-            if (callee := _resolve_call(proj, mi, f, ref)) is not None]
+            if (callee := resolve_call(proj, mi, f, ref)) is not None]
 
     for _ in range(_MAX_ROUNDS):
         changed = False
@@ -179,8 +183,10 @@ def _cycles(edges: list[_Edge]) -> list[list[str]]:
     return cycles
 
 
-def analyze_locks(modules: dict[str, ModuleInfo]) -> list[Finding]:
-    proj = Project(modules)
+def analyze_locks(modules: dict[str, ModuleInfo],
+                  proj: Optional[Project] = None) -> list[Finding]:
+    if proj is None:
+        proj = Project(modules)
     eff_locks, eff_block, resolved = _fixpoint(proj)
 
     findings: list[Finding] = []

@@ -337,21 +337,7 @@ class ModuleCollector(ast.NodeVisitor):
         return None
 
     def _call_ref(self, fn: ast.expr) -> Optional[tuple]:
-        if isinstance(fn, ast.Name):
-            return ("name", fn.id)
-        if isinstance(fn, ast.Attribute):
-            v = fn.value
-            if isinstance(v, ast.Name):
-                if v.id == "self":
-                    return ("self", fn.attr)
-                if v.id in self.mi.imports:
-                    return ("alias", v.id, fn.attr)
-                return ("unique", fn.attr)
-            if isinstance(v, ast.Attribute) and \
-                    isinstance(v.value, ast.Name) and v.value.id == "self":
-                return ("selfattr", v.attr, fn.attr)
-            return ("unique", fn.attr)
-        return None
+        return call_ref(fn, self.mi)
 
     def visit_Call(self, node: ast.Call) -> None:
         f = self._func
@@ -366,6 +352,30 @@ class ModuleCollector(ast.NodeVisitor):
             if ref is not None:
                 f.calls.append((ref, node.lineno, held, wlines))
         self.generic_visit(node)
+
+
+def call_ref(fn: ast.expr, mi: ModuleInfo) -> Optional[tuple]:
+    """Classify a call's callee expression into a resolvable reference.
+
+    Shared by the lock graph and the value-flow engine (dataflow.py) so
+    both layers agree on what a call site *is* before either resolves
+    it against the project call graph.
+    """
+    if isinstance(fn, ast.Name):
+        return ("name", fn.id)
+    if isinstance(fn, ast.Attribute):
+        v = fn.value
+        if isinstance(v, ast.Name):
+            if v.id == "self":
+                return ("self", fn.attr)
+            if v.id in mi.imports:
+                return ("alias", v.id, fn.attr)
+            return ("unique", fn.attr)
+        if isinstance(v, ast.Attribute) and \
+                isinstance(v.value, ast.Name) and v.value.id == "self":
+            return ("selfattr", v.attr, fn.attr)
+        return ("unique", fn.attr)
+    return None
 
 
 def collect_module(name: str, path: str, source: str) -> ModuleInfo:

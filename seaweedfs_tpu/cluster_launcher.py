@@ -161,6 +161,7 @@ class LocalCluster:
             self._reap_dead()
             for murl in self.master_urls:
                 try:
+                    # seaweedlint: disable=SW601 — launcher readiness poll on localhost: bounded by its own deadline loop + 2s timeout, runs before the cluster (and its breaker state) exists
                     with urllib.request.urlopen(
                             f"http://{murl}/cluster/status",
                             timeout=2) as r:

@@ -46,6 +46,15 @@ from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
+from ..util import bufcheck
+
+# Arm the runtime pooled-buffer checker straight from the environment
+# so `SEAWEED_BUFCHECK=1 python -m ...` works for any pipeline process
+# (scripts/pipeline_smoke.sh under lint_gate), not just pytest runs
+# where conftest installs it. No-op (and zero per-call cost) when the
+# variable is unset.
+bufcheck.install_from_env()
+
 #: Stage-queue depth: 2 = classic double buffering (config default).
 DEPTH = 2
 
@@ -168,16 +177,21 @@ class HostBufferPool:
         for _ in range(count):
             m = mmap.mmap(-1, nbytes)
             self._maps.append(m)
-            self._free.put(np.frombuffer(m, dtype=np.uint8))
+            buf = np.frombuffer(m, dtype=np.uint8)
+            bufcheck.register(buf, m)
+            self._free.put(buf)
 
     def acquire(self, timeout: Optional[float] = None) -> np.ndarray:
         """A free (nbytes,) uint8 buffer; blocks until one is
         recycled. Raises ``queue.Empty`` on timeout."""
-        return self._free.get(timeout=timeout) if timeout is not None \
+        buf = self._free.get(timeout=timeout) if timeout is not None \
             else self._free.get()
+        bufcheck.on_acquire(buf)
+        return buf
 
     def release(self, buf: np.ndarray) -> None:
         """Return a buffer obtained from :meth:`acquire`."""
+        bufcheck.on_release(buf)
         self._free.put(buf)
 
     def in_flight(self) -> int:

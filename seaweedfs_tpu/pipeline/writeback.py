@@ -28,6 +28,8 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ..util import bufcheck
+
 #: Linux UIO_MAXIOV; one pwritev can scatter at most this many
 #: segments, longer row lists are chunked.
 IOV_MAX = 1024
@@ -163,7 +165,10 @@ class WriterPool:
         if fd is None:
             raise WriterError(f"writeback: {path!r} not opened")
         q = self._queues[hash(path) % self.threads]
-        q.put((fd, offset, rows, token))
+        # Under SEAWEED_BUFCHECK, remember which pooled slabs (and
+        # generations) these rows view, so the worker can detect the
+        # slab being recycled while the write is still in flight.
+        q.put((fd, offset, rows, token, bufcheck.tag_rows(rows)))
 
     def failed(self) -> bool:
         return bool(self._errors)
@@ -214,7 +219,8 @@ class WriterPool:
             item = q.get()
             if item is _END:
                 return
-            fd, offset, rows, token = item
+            fd, offset, rows, token = item[:4]
+            tags = item[4] if len(item) > 4 else None
             if self._errors:
                 # fail fast but keep draining (and keep firing tokens
                 # so pooled buffers are not leaked on the error path)
@@ -223,7 +229,11 @@ class WriterPool:
                 continue
             t0 = time.perf_counter()
             try:
+                bufcheck.verify_rows(tags, where="before pwritev")
                 wrote = pwrite_rows(fd, offset, rows)
+                # re-check AFTER the write: a recycle that raced the
+                # pwritev corrupted the bytes already on disk
+                bufcheck.verify_rows(tags, where="after pwritev")
                 with self._busy_lock:
                     self.bytes_written += wrote
                     self.busy_seconds += time.perf_counter() - t0

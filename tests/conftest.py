@@ -49,6 +49,24 @@ from seaweedfs_tpu.util import lockcheck  # noqa: E402
 
 lockcheck.install_from_env()
 
+# ---------------------------------------------------------------------------
+# Runtime pooled-buffer checking (the dynamic half of SW5xx).
+#
+# Armed for the whole tier-1 suite: HostBufferPool slabs are
+# generation-tagged and poisoned on recycle, and the writeback workers
+# verify every positioned write's source generation before and after
+# the pwritev — a pooled view consumed after its recycle (the PR 12
+# ascontiguousarray race class) fails deterministically as a
+# WriterError instead of as rare shard corruption. Opt out with
+# SEAWEED_BUFCHECK=0; use =protect to also PROT_NONE free slabs.
+# ---------------------------------------------------------------------------
+
+os.environ.setdefault("SEAWEED_BUFCHECK", "1")
+
+from seaweedfs_tpu.util import bufcheck  # noqa: E402
+
+bufcheck.install_from_env()
+
 
 def pytest_configure(config):
     # Tier-1 runs with -m 'not slow'; the slow tier holds the
@@ -60,11 +78,17 @@ def pytest_configure(config):
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     viols = lockcheck.violations()
-    if not viols:
-        return
-    terminalreporter.section("seaweed lockcheck: lock-order violations")
-    for v in viols:
-        terminalreporter.write_line(v.describe())
+    if viols:
+        terminalreporter.section(
+            "seaweed lockcheck: lock-order violations")
+        for v in viols:
+            terminalreporter.write_line(v.describe())
+    bviols = bufcheck.violations()
+    if bviols:
+        terminalreporter.section(
+            "seaweed bufcheck: dangling pooled-buffer views")
+        for v in bviols:
+            terminalreporter.write_line(v)
 
 
 def pytest_sessionfinish(session, exitstatus):

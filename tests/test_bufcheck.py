@@ -1,0 +1,126 @@
+"""util/bufcheck: the runtime half of the SW5xx buffer-lifetime rules.
+
+The headline test injects the PR 12 race deterministically: a
+positioned write is parked inside ``pwrite_rows`` while the pooled
+slab its rows view is recycled, and the writer pool must fail with a
+WriterError naming the dangling view — instead of silently writing
+poison to disk.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.pipeline import writeback
+from seaweedfs_tpu.pipeline.pipe import HostBufferPool
+from seaweedfs_tpu.util import bufcheck
+
+
+@pytest.fixture(autouse=True)
+def _armed():
+    # conftest arms poison mode for the whole suite; make each test
+    # start from that state and leave no provoked violations behind.
+    bufcheck.install(protect=False)
+    yield
+    bufcheck.install(protect=False)
+    bufcheck.reset(violations_only=True)
+
+
+def test_generation_bump_and_poison():
+    pool = HostBufferPool(1 << 14, 1)
+    buf = pool.acquire()
+    buf[:] = 7
+    tags = bufcheck.tag_rows([buf[100:200]])
+    assert tags and tags[0][1] == 0
+    bufcheck.verify_rows(tags)  # generation still current: silent
+    pool.release(buf)
+    assert bufcheck.is_poisoned(buf)
+    with pytest.raises(bufcheck.DanglingViewError) as ei:
+        bufcheck.verify_rows(tags, where="test")
+    assert "recycled" in str(ei.value)
+    assert bufcheck.violations()
+
+
+def test_ascontiguousarray_view_is_tracked_but_copy_escapes():
+    # the exact PR 12 trap: ascontiguousarray on an already-contiguous
+    # row hands back the input VIEW, so it must stay tracked; an
+    # explicit copy (the shipped flatten() fix) must not be.
+    pool = HostBufferPool(1 << 14, 1)
+    buf = pool.acquire()
+    row = np.ascontiguousarray(buf[256:512])
+    assert bufcheck.tag_rows([row]) is not None
+    assert bufcheck.tag_rows([buf[256:512].flatten()]) is None
+    pool.release(buf)
+
+
+def test_writerpool_detects_in_flight_recycle(tmp_path, monkeypatch):
+    """Deterministic PR 12 injection: recycle the slab while its rows
+    sit inside pwrite_rows; the after-write verify must trip."""
+    started, unblock = threading.Event(), threading.Event()
+    real = writeback.pwrite_rows
+
+    def parked(fd, offset, rows):
+        started.set()
+        assert unblock.wait(5)
+        return real(fd, offset, rows)
+
+    monkeypatch.setattr(writeback, "pwrite_rows", parked)
+    pool = HostBufferPool(1 << 14, 1)
+    wp = writeback.WriterPool(threads=1, queue_depth=4)
+    path = str(tmp_path / "shard.dat")
+    wp.open_file(path)
+    buf = pool.acquire()
+    buf[:] = 3
+    wp.submit(path, 0, [buf[:4096]])
+    assert started.wait(5)          # worker is inside the "pwritev"
+    pool.release(buf)               # the race: recycle mid-write
+    unblock.set()
+    with pytest.raises(writeback.WriterError) as ei:
+        wp.close()
+    assert "recycled" in str(ei.value)
+    assert bufcheck.violations()
+
+
+def test_writerpool_clean_when_release_waits_for_token(tmp_path):
+    """The correct protocol — recycle gated on the BatchToken — never
+    trips the checker."""
+    pool = HostBufferPool(1 << 14, 1)
+    wp = writeback.WriterPool(threads=1, queue_depth=4)
+    path = str(tmp_path / "shard.dat")
+    wp.open_file(path)
+    buf = pool.acquire()
+    buf[:] = 9
+    token = writeback.BatchToken(1, lambda: pool.release(buf))
+    wp.submit(path, 0, [buf[:4096]], token)
+    wp.close()
+    assert not bufcheck.violations()
+    assert os.path.getsize(path) == 4096
+    with open(path, "rb") as f:
+        assert f.read(16) == b"\x09" * 16  # real bytes, not poison
+
+
+def test_protect_mode_restores_access_on_acquire():
+    bufcheck.install(protect=True)
+    if not bufcheck.protect_mode():  # no libc mprotect on this OS
+        pytest.skip("mprotect unavailable")
+    pool = HostBufferPool(1 << 14, 1)
+    buf = pool.acquire()
+    buf[0] = 1
+    pool.release(buf)               # slab is now PROT_NONE: hands off
+    buf2 = pool.acquire()           # access restored
+    buf2[0] = 2
+    assert buf2[0] == 2
+    pool.release(buf2)
+    bufcheck.uninstall()            # drop the protection before GC
+
+
+def test_install_from_env_modes(monkeypatch):
+    bufcheck.uninstall()
+    monkeypatch.setenv("SEAWEED_BUFCHECK", "0")
+    assert not bufcheck.install_from_env()
+    assert bufcheck.tag_rows([np.zeros(4, np.uint8)]) is None
+    monkeypatch.setenv("SEAWEED_BUFCHECK", "1")
+    assert bufcheck.install_from_env()
+    assert bufcheck.enabled() and not bufcheck.protect_mode()
