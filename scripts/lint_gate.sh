@@ -60,6 +60,20 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# Checkpoint-plane smoke (docs/workloads.md): a sharded jax.Array
+# pytree saved through a subprocess S3 gateway restores sha256-
+# identical onto a 2-process jax.distributed CPU mesh, with each
+# process range-reading only its own devices' shard bytes, and a
+# corrupted shard failing closed.
+bash scripts/ckpt_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: ckpt_smoke failed (exit $rc) — the checkpoint" \
+         "save/restore plane regressed; see scripts/ckpt_smoke.sh" >&2
+    exit "$rc"
+fi
+
 # Observability-plane smoke (docs/observability.md): SLO burn-rate
 # math, the burn-rate gauges' exposition, a profiler burst, and trace
 # stitching — in-process, a few seconds.

@@ -16,6 +16,14 @@ and recompiles every iteration. These rules police dispatch shape:
   literal (list/dict/set/comprehension) at a ``static_argnums``
   position (or a ``static_argnames`` keyword) — TypeError at trace
   time, or a silent cache miss per call if __eq__-abused.
+- SW704 (warning): ``jax.device_put`` in a loop whose DATA argument is
+  loop-invariant while the DEVICE argument tracks the loop variable —
+  the per-device placement loop a sharded restore is tempted to write;
+  ONE ``jax.device_put(x, NamedSharding(mesh, spec))`` (or
+  ``make_array_from_callback``, ckpt/store.py) places every shard in
+  one dispatch. When BOTH arguments depend on the loop variable the
+  loop is a legitimate per-shard transfer of distinct blocks and
+  neither SW702 nor SW704 fires.
 """
 
 from __future__ import annotations
@@ -34,6 +42,15 @@ _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
                ast.DictComp, ast.GeneratorExp)
 
 _SHARD_NAME_RE = re.compile(r"^_?shard_map$")
+
+_DEVICE_KWARGS = {"device", "sharding", "dst"}
+
+
+def _names(node: Optional[ast.AST]) -> set[str]:
+    """Every ``Name`` identifier referenced under ``node``."""
+    if node is None:
+        return set()
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
 def _jax_call_kind(c: ast.Call, mi: ModuleInfo) -> Optional[str]:
@@ -93,6 +110,8 @@ class _Scope(ast.NodeVisitor):
         self.qualname = qualname
         self.findings = findings
         self.loop_depth = 0
+        #: one set of bound loop-target names per enclosing loop
+        self.loop_vars: list[set[str]] = []
         #: name -> (static positions, static names, jit line)
         self.jitted: dict[str, tuple] = {}
 
@@ -104,16 +123,18 @@ class _Scope(ast.NodeVisitor):
     visit_ClassDef = visit_FunctionDef
     visit_Lambda = visit_FunctionDef
 
-    def _loop(self, node, parts):
+    def _loop(self, node, parts, targets=frozenset()):
         self.loop_depth += 1
+        self.loop_vars.append(set(targets))
         for name in parts:
             for ch in getattr(node, name, []) or []:
                 self.visit(ch)
+        self.loop_vars.pop()
         self.loop_depth -= 1
 
     def visit_For(self, node):  # noqa: N802
         self.visit(node.iter)
-        self._loop(node, ("body",))
+        self._loop(node, ("body",), _names(node.target))
         for ch in node.orelse:
             self.visit(ch)
 
@@ -127,7 +148,10 @@ class _Scope(ast.NodeVisitor):
 
     def _comp(self, node):
         self.loop_depth += 1
+        self.loop_vars.append(
+            set().union(*(_names(g.target) for g in node.generators)))
         self.generic_visit(node)
+        self.loop_vars.pop()
         self.loop_depth -= 1
 
     visit_ListComp = _comp
@@ -158,6 +182,36 @@ class _Scope(ast.NodeVisitor):
                 f"it or cache the jitted callable (see "
                 f"parallel/mesh.py _auto_steps)"))
         elif kind == "device_put" and self.loop_depth > 0:
+            self._check_device_put(node)
+        if kind == "jit":
+            self._check_inline_static(node)
+        self._check_jitted_call(node)
+        self.generic_visit(node)
+
+    def _check_device_put(self, node: ast.Call):
+        bound = set().union(*self.loop_vars) if self.loop_vars \
+            else set()
+        data = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "x"), None)
+        dev = node.args[1] if len(node.args) > 1 else next(
+            (kw.value for kw in node.keywords
+             if kw.arg in _DEVICE_KWARGS), None)
+        data_dep = bool(_names(data) & bound)
+        dev_dep = bool(_names(dev) & bound)
+        if dev_dep and not data_dep:
+            self.findings.append(Finding(
+                "SW704", "warning", self.path, node.lineno,
+                self.qualname,
+                "jax.device_put of a loop-invariant array onto a "
+                "per-iteration device — one device_put with a "
+                "NamedSharding (or make_array_from_callback, see "
+                "ckpt/store.py restore) places every shard in a "
+                "single dispatch"))
+        elif dev_dep and data_dep:
+            # distinct data onto distinct devices each iteration: a
+            # legitimate per-shard transfer, not a dispatch hazard
+            return
+        else:
             self.findings.append(Finding(
                 "SW702", "warning", self.path, node.lineno,
                 self.qualname,
@@ -165,10 +219,6 @@ class _Scope(ast.NodeVisitor):
                 "H2D behind compute — use the double-buffered "
                 "prepare path (pipeline double_buffer) or donation "
                 "instead of a fresh transfer per iteration"))
-        if kind == "jit":
-            self._check_inline_static(node)
-        self._check_jitted_call(node)
-        self.generic_visit(node)
 
     def _flag_703(self, line, what):
         self.findings.append(Finding(

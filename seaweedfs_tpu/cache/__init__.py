@@ -13,8 +13,11 @@ from .chunk_cache import (METRICS, ChunkCache, SegmentedLRU, chunk_key,
                           configure_global, fid_volume, from_config,
                           global_chunk_cache)
 from .disk_tier import DiskTier
-from . import invalidation
+from .readahead import (Prefetcher, ReadaheadWindow, shared_prefetcher)
+from . import invalidation, readahead
 
-__all__ = ["METRICS", "ChunkCache", "DiskTier", "SegmentedLRU",
-           "chunk_key", "configure_global", "fid_volume", "from_config",
-           "global_chunk_cache", "invalidation"]
+__all__ = ["METRICS", "ChunkCache", "DiskTier", "Prefetcher",
+           "ReadaheadWindow", "SegmentedLRU", "chunk_key",
+           "configure_global", "fid_volume", "from_config",
+           "global_chunk_cache", "invalidation", "readahead",
+           "shared_prefetcher"]

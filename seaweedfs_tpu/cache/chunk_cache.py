@@ -156,6 +156,8 @@ class ChunkCache:
                  disk_dir: Optional[str] = None,
                  disk_capacity_bytes: int = 256 * 1024 * 1024,
                  disk_segments: int = 4,
+                 disk_compaction: bool = True,
+                 disk_hot_min_hits: int = 1,
                  ttl_seconds: float = 0.0,
                  admission_max_fraction: float = 0.125,
                  protected_fraction: float = 0.8,
@@ -164,7 +166,9 @@ class ChunkCache:
         self._lock = threading.RLock()
         self._mem = SegmentedLRU(capacity_bytes, protected_fraction)
         self._disk = DiskTier(disk_dir, disk_capacity_bytes,
-                              disk_segments, clock=clock) \
+                              disk_segments, clock=clock,
+                              compaction=disk_compaction,
+                              hot_min_hits=disk_hot_min_hits) \
             if disk_dir else None
         self.ttl = float(ttl_seconds)
         #: Admission control: one item larger than this never enters the
@@ -426,6 +430,12 @@ class ChunkCache:
                     self._disk.segment_cap * self._disk.segments
                 out["disk_evictions"] = self._disk.evictions
                 out["disk_dir"] = str(self._disk.dir)
+                out["disk_compaction"] = self._disk.compaction
+                out["compactions"] = self._disk.compactions
+                out["compaction_bytes_copied"] = \
+                    self._disk.compaction_bytes_copied
+                out["compaction_bytes_dropped"] = \
+                    self._disk.compaction_bytes_dropped
             return out
 
     # handy for tests
@@ -473,6 +483,8 @@ def from_config(conf: dict, clock=time.time) -> ChunkCache:
         disk_capacity_bytes=int(lookup(conf, "cache.disk.capacity_bytes",
                                        256 * 1024 * 1024)),
         disk_segments=int(lookup(conf, "cache.disk.segments", 4)),
+        disk_compaction=bool(lookup(conf, "cache.disk.compaction", True)),
+        disk_hot_min_hits=int(lookup(conf, "cache.disk.hot_min_hits", 1)),
         ttl_seconds=float(lookup(conf, "cache.ttl_seconds", 0.0)),
         admission_max_fraction=float(
             lookup(conf, "cache.admission_max_fraction", 0.125)),

@@ -10,6 +10,16 @@ whole-segment, so the tier needs no per-record free-space bookkeeping.
 Crash restart: the index is rebuilt by scanning every segment file
 (oldest mtime first, so the newest record for a key wins) and the active
 segment's torn tail — a record cut mid-write — is truncated away.
+
+Hot-forward compaction (docs/workloads.md): rotation used to drop a
+whole generation — including records still taking hits. With
+``compaction=True`` (the default), rotating into a segment first copies
+its still-hot records (``hits >= hot_min_hits`` since they last
+survived, unexpired) forward into the fresh segment, hottest first, up
+to half the segment so rotation still reclaims space. Copied records
+have their heat reset — surviving the NEXT rotation requires being hit
+again, so a once-hot key cannot ride forward forever. Emits
+``seaweed_compaction_{segments,bytes_copied,bytes_dropped}_total``.
 """
 
 from __future__ import annotations
@@ -20,16 +30,29 @@ import time
 from pathlib import Path
 from typing import Iterator, Optional
 
+from .readahead import METRICS as _SEAWEED_METRICS
+
 #: magic(1) flags(1) key_len(2) volume(4) data_len(4) expires_epoch(8)
 _HEADER = struct.Struct(">BBHId")
 _MAGIC = 0xC5
 #: One record may not claim more than this fraction of a segment, or a
 #: single giant put would wipe a whole generation for one entry.
 _MAX_RECORD_FRACTION = 0.5
+#: Compaction may fill at most this fraction of the fresh segment with
+#: carried-forward hot records — rotation must still free space.
+_COMPACT_MAX_FRACTION = 0.5
+
+_M_COMPACT_SEGMENTS = _SEAWEED_METRICS.counter(
+    "compaction_segments_total")
+_M_COMPACT_COPIED = _SEAWEED_METRICS.counter(
+    "compaction_bytes_copied_total")
+_M_COMPACT_DROPPED = _SEAWEED_METRICS.counter(
+    "compaction_bytes_dropped_total")
 
 
 class _IndexEntry:
-    __slots__ = ("segment", "offset", "size", "volume", "expires")
+    __slots__ = ("segment", "offset", "size", "volume", "expires",
+                 "hits", "last_access")
 
     def __init__(self, segment: int, offset: int, size: int,
                  volume: Optional[int], expires: float):
@@ -38,6 +61,10 @@ class _IndexEntry:
         self.size = size
         self.volume = volume
         self.expires = expires
+        #: read hits since this record was written (or last carried
+        #: forward) — the compaction heat signal
+        self.hits = 0
+        self.last_access = 0.0
 
 
 class DiskTier:
@@ -45,18 +72,24 @@ class DiskTier:
 
     def __init__(self, directory: str | Path,
                  capacity_bytes: int = 256 * 1024 * 1024,
-                 segments: int = 4, clock=time.time):
+                 segments: int = 4, clock=time.time,
+                 compaction: bool = True, hot_min_hits: int = 1):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.segments = max(2, int(segments))
         self.segment_cap = max(4096, int(capacity_bytes) // self.segments)
         self.clock = clock
+        self.compaction = bool(compaction)
+        self.hot_min_hits = max(1, int(hot_min_hits))
         self._lock = threading.RLock()
         self._index: dict[str, _IndexEntry] = {}
         self._sizes = [0] * self.segments
         self._fh: list = [None] * self.segments
         self._active = 0
         self.evictions = 0
+        self.compactions = 0
+        self.compaction_bytes_copied = 0
+        self.compaction_bytes_dropped = 0
         self._load()
 
     # ------------- segment files -------------
@@ -138,32 +171,84 @@ class DiskTier:
         with self._lock:
             if self._sizes[self._active] + rec_len > self.segment_cap:
                 evicted = self._rotate()
-            i = self._active
-            f = self._file(i)
-            f.seek(self._sizes[i])
-            f.write(_HEADER.pack(_MAGIC, 0, len(kb), volume or 0,
-                                 float(expires)))
-            f.write(len(data).to_bytes(4, "big"))
-            f.write(kb)
-            data_off = self._sizes[i] + _HEADER.size + 4 + len(kb)
-            f.write(data)
-            f.flush()
-            self._sizes[i] += rec_len
-            self._index[key] = _IndexEntry(i, data_off, len(data),
-                                           volume, float(expires))
+            # seaweedlint: disable=SW103 — the tier lock's whole job is serializing this cache file; the append must see the post-rotation handle
+            self._append_locked(key, kb, data, volume, float(expires))
         return evicted
+
+    def _append_locked(self, key: str, kb: bytes, data: bytes,
+                       volume: Optional[int], expires: float) -> None:
+        """Append one record to the active segment (caller locks)."""
+        i = self._active
+        f = self._file(i)
+        f.seek(self._sizes[i])
+        f.write(_HEADER.pack(_MAGIC, 0, len(kb), volume or 0, expires))
+        f.write(len(data).to_bytes(4, "big"))
+        f.write(kb)
+        data_off = self._sizes[i] + _HEADER.size + 4 + len(kb)
+        f.write(data)
+        f.flush()
+        self._sizes[i] += _HEADER.size + 4 + len(kb) + len(data)
+        self._index[key] = _IndexEntry(i, data_off, len(data),
+                                       volume, expires)
 
     def _rotate(self) -> int:
         nxt = (self._active + 1) % self.segments
-        dead = [k for k, e in self._index.items() if e.segment == nxt]
-        for k in dead:
+        doomed = [(k, e) for k, e in self._index.items()
+                  if e.segment == nxt]
+        # hot-forward compaction: read the victim generation's
+        # still-hot records BEFORE truncating it, hottest first, under
+        # a byte budget that keeps rotation freeing space
+        survivors: list[tuple[str, bytes, _IndexEntry]] = []
+        if self.compaction and doomed:
+            now = self.clock()
+            budget = int(self.segment_cap * _COMPACT_MAX_FRACTION)
+            hot = sorted(
+                (pair for pair in doomed
+                 if pair[1].hits >= self.hot_min_hits
+                 and not (pair[1].expires and now > pair[1].expires)),
+                key=lambda p: (-p[1].hits, -p[1].last_access))
+            f = self._file(nxt)
+            used = 0
+            for k, e in hot:
+                rec_len = _HEADER.size + 4 + len(k.encode("utf-8")) \
+                    + e.size
+                if used + rec_len > budget:
+                    break
+                f.seek(e.offset)
+                data = f.read(e.size)
+                if len(data) == e.size:
+                    survivors.append((k, data, e))
+                    used += rec_len
+        kept = {k for k, _, _ in survivors}
+        dead = 0
+        dropped_bytes = 0
+        for k, e in doomed:
             del self._index[k]
-        self.evictions += len(dead)
+            if k not in kept:
+                dead += 1
+                dropped_bytes += e.size
+        self.evictions += dead
         f = self._file(nxt)
         f.truncate(0)
         self._sizes[nxt] = 0
         self._active = nxt
-        return len(dead)
+        copied_bytes = 0
+        for k, data, e in survivors:
+            self._append_locked(k, k.encode("utf-8"), data, e.volume,
+                                e.expires)
+            copied_bytes += len(data)
+            # heat resets: surviving the NEXT rotation requires fresh
+            # hits, so a once-hot record cannot ride forward forever
+        if self.compaction:
+            self.compactions += 1
+            self.compaction_bytes_copied += copied_bytes
+            self.compaction_bytes_dropped += dropped_bytes
+            _M_COMPACT_SEGMENTS.inc()
+            if copied_bytes:
+                _M_COMPACT_COPIED.inc(copied_bytes)
+            if dropped_bytes:
+                _M_COMPACT_DROPPED.inc(dropped_bytes)
+        return dead
 
     def get(self, key: str
             ) -> Optional[tuple[bytes, Optional[int], float]]:
@@ -181,6 +266,8 @@ class DiskTier:
             if len(data) != e.size:
                 del self._index[key]
                 return None
+            e.hits += 1
+            e.last_access = self.clock()
             return data, e.volume, e.expires
 
     def remove(self, key: str) -> bool:

@@ -1,0 +1,19 @@
+"""Checkpoint & dataloader workload plane (docs/workloads.md).
+
+The end-to-end ML consumer of the store: save a sharded ``jax.Array``
+pytree through the S3 gateway as one object per (param, shard) plus a
+committed manifest, restore it onto a mesh with each process
+range-reading only its own shards' bytes, and stream data objects in
+seeded shuffled scans with bounded prefetch.
+"""
+
+from .loader import ObjectLoader
+from .manifest import (FORMAT, Manifest, ManifestError, ParamSpec,
+                       ShardEntry, spec_from_json, spec_to_json)
+from .s3client import GatewayClient, GatewayError
+from .store import (CheckpointError, CheckpointStore, CorruptShardError)
+
+__all__ = ["FORMAT", "CheckpointError", "CheckpointStore",
+           "CorruptShardError", "GatewayClient", "GatewayError",
+           "Manifest", "ManifestError", "ObjectLoader", "ParamSpec",
+           "ShardEntry", "spec_from_json", "spec_to_json"]

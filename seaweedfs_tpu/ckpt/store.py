@@ -1,0 +1,425 @@
+"""Sharded jax.Array checkpoint store over the S3 gateway.
+
+Save (multi-process safe, docs/workloads.md "Checkpoint layout"):
+
+1. every process writes one object per (param, local shard) for the
+   shards it OWNS (``replica_id == 0`` — exactly one writer per global
+   shard no matter how the array is replicated), named by the shard's
+   global start indices so no coordination is needed to agree on keys;
+2. every process writes its part-manifest to
+   ``{root}/_parts/{process_index}.json``;
+3. process 0 waits for all parts, merges them, orders each param's
+   shard table canonically and assigns packed byte ranges
+   (``Manifest.finalize``), and writes ``{root}/manifest.json`` — the
+   COMMIT POINT; the other processes poll for it as the save barrier.
+
+Restore: read the manifest, build each param's ``NamedSharding`` from
+the stored ``PartitionSpec`` and the live mesh, and let
+``jax.make_array_from_callback`` pull exactly the blocks this
+process's addressable devices need — each block is a RANGED read of
+the covering shard object(s) (an axis-0 slice of a saved shard is
+contiguous in its C-order bytes, so restoring onto more processes
+than saved sub-range-reads instead of over-reading). Block bytes stage
+through a :class:`~seaweedfs_tpu.pipeline.pipe.HostBufferPool` slab
+(bounding peak host memory and running under bufcheck), are sha256-
+verified against the manifest whenever a whole shard object is read,
+and a mismatch fails closed with :class:`CorruptShardError` — a
+checkpoint never half-loads.
+
+The per-shard ``device_put`` loop the naive restore would write is
+exactly what seaweedlint SW704 flags; ``make_array_from_callback``
+keeps placement inside jax (tests/test_dataflow_rules.py pins the
+fixture from this file's history).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..util import glog, tracing
+from .manifest import (Manifest, ManifestError, ParamSpec,
+                       ShardEntry, spec_from_json, spec_to_json)
+from .s3client import GatewayClient
+
+
+class CheckpointError(Exception):
+    """Save/restore failed in a way retrying won't fix."""
+
+
+class CorruptShardError(CheckpointError):
+    """A shard object's bytes do not hash to the manifest's sha256."""
+
+
+def _path_name(path) -> str:
+    """jax tree path -> stable object-key-safe param name."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:  # pragma: no cover — future jax key types
+            parts.append(str(p))
+    return "/".join(parts) or "_root"
+
+
+def _norm_index(index, shape: tuple) -> tuple[tuple, tuple]:
+    """A device's index (tuple of slices) -> (start, stop) int tuples."""
+    start, stop = [], []
+    for sl, dim in zip(index, shape):
+        start.append(0 if sl.start is None else int(sl.start))
+        stop.append(dim if sl.stop is None else int(sl.stop))
+    return tuple(start), tuple(stop)
+
+
+class CheckpointStore:
+    """Checkpoints under ``{bucket}/{prefix}/{name}/`` on one gateway."""
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, gateway_url: str, bucket: str = "ckpt",
+                 prefix: str = "checkpoints",
+                 client: Optional[GatewayClient] = None,
+                 barrier_timeout: float = 120.0):
+        self.client = client or GatewayClient(gateway_url)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.barrier_timeout = float(barrier_timeout)
+
+    def _root(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    # ------------- save -------------
+
+    def save(self, name: str, tree: Any) -> Manifest:
+        """Write one checkpoint; every participating jax process must
+        call this with its own (process-local view of the) ``tree``.
+        Returns the merged manifest (process 0 builds it; the others
+        re-read the committed one)."""
+        import jax
+
+        with tracing.span("ckpt.save"):
+            pid = jax.process_index()
+            nproc = jax.process_count()
+            root = self._root(name)
+            self.client.ensure_bucket(self.bucket)
+            if pid == 0:
+                # Overwriting a committed checkpoint under the same
+                # name: clear stale parts FIRST, then the manifest —
+                # its absence is the "cleanup done" signal the other
+                # processes wait on, so no process writes a fresh part
+                # that cleanup could swallow, and the old manifest can
+                # never double as OUR commit point.
+                for i in range(nproc):
+                    self.client.delete(self.bucket,
+                                       f"{root}/_parts/{i}.json")
+                self.client.delete(self.bucket,
+                                   f"{root}/{self.MANIFEST}")
+            else:
+                self._await_absent(f"{root}/{self.MANIFEST}")
+            leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+            if not leaves:
+                raise CheckpointError("empty pytree")
+            part = Manifest({})
+            for path, leaf in leaves:
+                part.params.append(self._save_leaf(root,
+                                                   _path_name(path),
+                                                   leaf, part))
+            self.client.put(self.bucket, f"{root}/_parts/{pid}.json",
+                            part.to_json(), "application/json")
+            if pid == 0:
+                man = self._merge_parts(root, nproc)
+                man.finalize()
+                man.validate()
+                self.client.put(self.bucket, f"{root}/{self.MANIFEST}",
+                                man.to_json(), "application/json")
+                glog.info("ckpt: committed %s (%d params, %d procs)",
+                          root, len(man.params), nproc)
+                return man
+            return self._await_manifest(root)
+
+    def _save_leaf(self, root: str, pname: str, leaf,
+                   part: Manifest) -> ParamSpec:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if not isinstance(leaf, jax.Array):
+            leaf = np.asarray(leaf)
+            # host arrays are "replicated": only process 0 writes them
+            spec_json = spec_to_json(PartitionSpec(*([None] *
+                                                     leaf.ndim)))
+            p = ParamSpec(pname, str(leaf.dtype), leaf.shape,
+                          spec_json)
+            if jax.process_index() == 0:
+                p.shards.append(self._put_block(
+                    root, pname, np.ascontiguousarray(leaf),
+                    tuple([0] * leaf.ndim), leaf.shape))
+            return p
+        sharding = leaf.sharding
+        if isinstance(sharding, NamedSharding):
+            spec_json = spec_to_json(sharding.spec)
+            if not part.mesh_axes:
+                part.mesh_axes.update(
+                    {str(k): int(v) for k, v in
+                     sharding.mesh.shape.items()})
+        else:
+            spec_json = spec_to_json(PartitionSpec(*([None] *
+                                                     leaf.ndim)))
+        p = ParamSpec(pname, str(leaf.dtype), leaf.shape, spec_json)
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one writer per global shard
+            start, stop = _norm_index(shard.index, leaf.shape)
+            block = np.ascontiguousarray(np.asarray(shard.data))
+            p.shards.append(self._put_block(root, pname, block,
+                                            start, stop))
+        return p
+
+    def _put_block(self, root: str, pname: str, block: np.ndarray,
+                   start: tuple, stop: tuple) -> ShardEntry:
+        data = block.tobytes()
+        key = f"{root}/{pname}/shard-" + \
+            "_".join(str(i) for i in start)
+        self.client.put(self.bucket, key, data)
+        return ShardEntry(key, start, stop, len(data),
+                          hashlib.sha256(data).hexdigest())
+
+    def _merge_parts(self, root: str, nproc: int) -> Manifest:
+        parts: dict[int, Manifest] = {}
+        deadline = time.monotonic() + self.barrier_timeout
+        while len(parts) < nproc:
+            for i in range(nproc):
+                if i in parts:
+                    continue
+                raw = self._get_if_exists(f"{root}/_parts/{i}.json")
+                if raw is not None:
+                    parts[i] = Manifest.from_json(raw)
+            if len(parts) < nproc:
+                if time.monotonic() > deadline:
+                    raise CheckpointError(
+                        f"save barrier: {len(parts)}/{nproc} part "
+                        f"manifests after {self.barrier_timeout}s")
+                time.sleep(0.05)
+        merged = Manifest({})
+        for i in sorted(parts):
+            for p in parts[i].params:
+                merged.mesh_axes.update(parts[i].mesh_axes)
+                try:
+                    mine = merged.param(p.name)
+                except ManifestError:
+                    merged.params.append(p)
+                    continue
+                seen = {s.start for s in mine.shards}
+                mine.shards.extend(s for s in p.shards
+                                   if s.start not in seen)
+        return merged
+
+    def _get_if_exists(self, key: str) -> Optional[bytes]:
+        import urllib.error
+        try:
+            return self.client.get(self.bucket, key)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def _await_absent(self, key: str) -> None:
+        deadline = time.monotonic() + self.barrier_timeout
+        while self.client.head(self.bucket, key) is not None:
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    f"save barrier: stale {key} never cleared "
+                    f"(process 0 missing?)")
+            time.sleep(0.05)
+
+    def _await_manifest(self, root: str) -> Manifest:
+        deadline = time.monotonic() + self.barrier_timeout
+        while True:
+            raw = self._get_if_exists(f"{root}/{self.MANIFEST}")
+            if raw is not None:
+                return Manifest.from_json(raw)
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    f"save barrier: no {self.MANIFEST} under {root} "
+                    f"after {self.barrier_timeout}s")
+            time.sleep(0.05)
+
+    # ------------- restore -------------
+
+    def read_manifest(self, name: str) -> Manifest:
+        raw = self._get_if_exists(
+            f"{self._root(name)}/{self.MANIFEST}")
+        if raw is None:
+            raise ManifestError(
+                f"no {self.MANIFEST} under {self._root(name)} — "
+                f"checkpoint absent or its save never committed")
+        man = Manifest.from_json(raw)
+        man.validate()
+        return man
+
+    def restore(self, name: str, mesh=None, template: Any = None,
+                pool=None) -> Any:
+        """Load one checkpoint onto ``mesh`` (default: the configured
+        process mesh). Returns a pytree shaped like ``template`` when
+        given (leaves matched by tree-path name), else a flat
+        ``{param_name: jax.Array}`` dict."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        man = self.read_manifest(name)
+        if mesh is None:
+            from ..parallel import mesh as mesh_mod
+            mesh = mesh_mod.configured_mesh() or mesh_mod.make_mesh()
+        own_pool = pool is None
+        if own_pool:
+            pool = self._make_pool(man)
+        arrays: dict[str, Any] = {}
+        try:
+            with tracing.span("ckpt.restore"):
+                for p in man.params:
+                    sharding = NamedSharding(mesh,
+                                             spec_from_json(p.spec))
+                    arrays[p.name] = self._restore_param(p, sharding,
+                                                         pool)
+                for arr in arrays.values():
+                    # pooled staging slabs recycle below; every block
+                    # must be on-device before then (bufcheck contract)
+                    arr.block_until_ready()
+        finally:
+            if own_pool:
+                pool = None  # slabs die with the pool
+        if template is None:
+            return arrays
+        paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+        leaves = []
+        for path, _ in paths:
+            pname = _path_name(path)
+            if pname not in arrays:
+                raise ManifestError(
+                    f"template leaf {pname!r} not in checkpoint")
+            leaves.append(arrays[pname])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _make_pool(self, man: Manifest):
+        from ..pipeline.pipe import HostBufferPool
+
+        biggest = max((s.nbytes for p in man.params
+                       for s in p.shards), default=1)
+        return HostBufferPool(max(4096, biggest), 4)
+
+    def _restore_param(self, p: ParamSpec, sharding, pool):
+        import jax
+
+        shape = tuple(p.shape)
+        dtype = np.dtype(p.dtype)
+        blocks: dict[tuple, np.ndarray] = {}
+
+        def fetch(index) -> np.ndarray:
+            start, stop = _norm_index(index, shape)
+            cached = blocks.get((start, stop))
+            if cached is None:
+                cached = self._read_block(p, start, stop, dtype, pool)
+                blocks[(start, stop)] = cached
+            return cached
+
+        return jax.make_array_from_callback(shape, sharding, fetch)
+
+    def _read_block(self, p: ParamSpec, start: tuple, stop: tuple,
+                    dtype: np.dtype, pool) -> np.ndarray:
+        """One device's block, assembled from the covering saved
+        shard(s) with ranged reads of exactly the bytes needed."""
+        shape = tuple(hi - lo for lo, hi in zip(start, stop))
+        out = np.empty(shape, dtype)
+        flat = out.reshape(shape[0] if shape else 1, -1) \
+            if shape else out.reshape(1, 1)
+        filled = 0
+        for s in sorted(p.shards, key=lambda s: s.start):
+            if s.start[1:] != start[1:] or s.stop[1:] != stop[1:]:
+                if self._intersects(s, start, stop):
+                    raise ManifestError(
+                        f"{p.name!r}: restore block {start}..{stop} "
+                        f"cuts shard {s.key} on a non-leading axis — "
+                        f"only axis-0 resharding is supported")
+                continue
+            lo = max(start[0] if start else 0, s.start[0] if s.start
+                     else 0)
+            hi = min(stop[0] if stop else 1, s.stop[0] if s.stop
+                     else 1)
+            if lo >= hi:
+                continue
+            row = int(np.prod(shape[1:], dtype=np.int64)) * \
+                dtype.itemsize if len(shape) > 1 else dtype.itemsize
+            off = (lo - (s.start[0] if s.start else 0)) * row
+            nbytes = (hi - lo) * row
+            raw = self._fetch_verified(p, s, off, nbytes, pool)
+            dst = flat[lo - (start[0] if start else 0):
+                       hi - (start[0] if start else 0)]
+            dst.reshape(-1).view(np.uint8)[:] = raw
+            filled += nbytes
+        if filled != out.nbytes:
+            raise ManifestError(
+                f"{p.name!r}: shards cover {filled} of {out.nbytes} "
+                f"bytes for block {start}..{stop}")
+        return out
+
+    @staticmethod
+    def _intersects(s: ShardEntry, start: tuple, stop: tuple) -> bool:
+        return all(lo < shi and slo < hi for lo, hi, slo, shi in
+                   zip(start, stop, s.start, s.stop))
+
+    def _fetch_verified(self, p: ParamSpec, s: ShardEntry, off: int,
+                        nbytes: int, pool) -> np.ndarray:
+        """Ranged read of ``[off, off+nbytes)`` from one shard object,
+        staged through a pooled slab; whole-shard reads verify the
+        manifest sha256 and fail closed on mismatch."""
+        data = self.client.get_range(self.bucket, s.key, off, nbytes)
+        if len(data) != nbytes:
+            raise CorruptShardError(
+                f"{p.name!r}: shard {s.key} range [{off}, "
+                f"{off + nbytes}) returned {len(data)} bytes")
+        buf = pool.acquire(timeout=30.0)
+        try:
+            view = buf[:nbytes]
+            view[:] = np.frombuffer(data, np.uint8)
+            if off == 0 and nbytes == s.nbytes:
+                digest = hashlib.sha256(view).hexdigest()
+                if digest != s.sha256:
+                    raise CorruptShardError(
+                        f"{p.name!r}: shard {s.key} sha256 {digest} "
+                        f"!= manifest {s.sha256} — refusing to load")
+            return view.copy()
+        finally:
+            pool.release(buf)
+
+    # ------------- listing -------------
+
+    def list_checkpoints(self) -> list[dict]:
+        """[{name, params, shards, bytes}] for every COMMITTED
+        checkpoint under the prefix (uncommitted saves are invisible,
+        matching restore's view)."""
+        out = []
+        pfx = f"{self.prefix}/" if self.prefix else ""
+        for key in self.client.list(self.bucket, pfx):
+            if not key.endswith(f"/{self.MANIFEST}"):
+                continue
+            name = key[len(pfx):-len(self.MANIFEST) - 1]
+            try:
+                man = Manifest.from_json(
+                    self.client.get(self.bucket, key))
+            except ManifestError as e:
+                glog.v(1, f"ckpt.list: skipping malformed manifest "
+                          f"{key}: {e}")
+                continue
+            out.append({
+                "name": name,
+                "params": len(man.params),
+                "shards": sum(len(p.shards) for p in man.params),
+                "bytes": sum(s.nbytes for p in man.params
+                             for s in p.shards)})
+        return sorted(out, key=lambda d: d["name"])

@@ -605,30 +605,9 @@ def _make_http_handler(fs: FilerServer):
         httpserver.admission_gate(Handler), "filer")
 
 
-def _parse_range(header, size: int):
-    """RFC 7233 single-range parse: (offset, length) or None to serve the
-    full body with 200 (unknown units and malformed values are ignored,
-    suffix ranges bytes=-N mean the LAST N bytes)."""
-    if not header or not header.startswith("bytes="):
-        return None
-    spec = header[6:].split(",")[0].strip()
-    lo, sep, hi = spec.partition("-")
-    if not sep:
-        return None
-    try:
-        if not lo:  # suffix: last N bytes
-            n = int(hi)
-            if n <= 0:
-                return None
-            offset = max(0, size - n)
-            return offset, size - offset
-        offset = int(lo)
-        stop = int(hi) + 1 if hi else size
-    except ValueError:
-        return None
-    if offset >= size:
-        return None
-    return offset, max(0, min(stop, size) - offset)
+#: The single-range parser now lives in util/httpserver.py so the
+#: filer, volume-server and S3 tiers slice ``bytes=a-b`` identically.
+_parse_range = httpserver.parse_range
 
 
 def _first_multipart_file(body: bytes, ctype: str) -> tuple[bytes, str]:

@@ -970,10 +970,13 @@ def _make_http_handler(vs: VolumeServer):
             glog.v(2, "volume http: " + fmt, *args)
 
         def _send(self, code: int, body: bytes,
-                  ctype: str = "application/octet-stream") -> None:
+                  ctype: str = "application/octet-stream",
+                  extra: dict | None = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -1046,9 +1049,37 @@ def _make_http_handler(vs: VolumeServer):
                     # on-read image scaling (weed/images)
                     from ..images import resized
                     data, mime = resized(data, w, h, q.get("mode", ""))
-                self._send(200, data,
-                           mime or "application/octet-stream")
-                vs.metrics.counter("read_requests", code="200").inc()
+                # RFC 7233 single range on the (possibly resized) body:
+                # shard restores range-read needles directly off the
+                # volume server, so 206/Content-Range must be exact.
+                rng_hdr = self.headers.get("Range")
+                rng = httpserver.parse_range(rng_hdr, len(data)) \
+                    if rng_hdr else None
+                if rng is not None:
+                    off, ln = rng
+                    self._send(
+                        206, data[off:off + ln],
+                        mime or "application/octet-stream",
+                        {"Accept-Ranges": "bytes",
+                         "Content-Range":
+                         f"bytes {off}-{off + ln - 1}/{len(data)}"})
+                    vs.metrics.counter("read_requests",
+                                       code="206").inc()
+                elif rng_hdr and rng_hdr.startswith("bytes="):
+                    # well-formed but unsatisfiable (or malformed spec):
+                    # answer 416 so a ranged reader never silently gets
+                    # the whole needle
+                    self._send(
+                        416, b"", "application/octet-stream",
+                        {"Content-Range": f"bytes */{len(data)}"})
+                    vs.metrics.counter("read_requests",
+                                       code="416").inc()
+                else:
+                    self._send(200, data,
+                               mime or "application/octet-stream",
+                               {"Accept-Ranges": "bytes"})
+                    vs.metrics.counter("read_requests",
+                                       code="200").inc()
             except faults.FaultDrop:
                 # Injected connection drop: no response, hard close.
                 # Answering 500 here would leave a healthy-looking

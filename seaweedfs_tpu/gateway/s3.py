@@ -23,10 +23,12 @@ import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
 from ..cache import invalidation as invalidation_mod
+from ..cache import readahead as readahead_mod
 from ..cluster import usage as usage_mod
 from ..cluster.filer_client import FilerClient, FilerClientError
 from ..pb import filer_pb2
@@ -116,6 +118,17 @@ class S3Gateway:
         #: or confirmed absent); before that, transient filer errors
         #: leave the gateway deny-all instead of open
         self._conf_loaded = False
+        #: Ranged-read readahead (docs/workloads.md): one window per
+        #: (path, etag) byte stream, LRU-bounded so churning keys can't
+        #: grow state; block cache keys inserted by prefetch and not
+        #: yet read live in _ra_prefetched for hit/wasted accounting.
+        self._ra_lock = threading.Lock()
+        self._ra_windows: OrderedDict[str, object] = OrderedDict()
+        self._ra_prefetched: set[str] = set()
+        #: block key -> Event set when its in-flight prefetch lands;
+        #: a foreground miss WAITS on this instead of re-fetching the
+        #: same block the prefetcher already has on the wire
+        self._ra_inflight: dict[str, threading.Event] = {}
 
     def _load_filer_identities(self) -> None:
         try:
@@ -377,6 +390,21 @@ class S3Gateway:
             raise S3Error("NoSuchKey", key)
         return e
 
+    #: Ranged reads cache in fixed blocks, so an arbitrary
+    #: (offset, length) stream mints at most size/RANGE_BLOCK distinct
+    #: keys per object version — never one key per request shape (the
+    #: whole-object-poisoning bug this replaced).
+    RANGE_BLOCK = 1 * 1024 * 1024
+    #: Streams with live readahead windows (LRU cap).
+    RANGE_STREAMS = 64
+    #: Readahead window ceiling, in RANGE_BLOCK units (8 MiB): deep
+    #: enough to hide filer latency, shallow enough that a seek does
+    #: not strand tens of MiB of wasted prefetch.
+    RANGE_WINDOW_UNITS = 8
+    #: Max blocks one prefetch filer read may claim: a foreground read
+    #: waiting on a claimed block waits for at most this much data.
+    PREFETCH_RUN_BLOCKS = 4
+
     def get_object(self, bucket: str, key: str, offset: int = 0,
                    length: Optional[int] = None) -> bytes:
         entry = self.get_object_entry(bucket, key)
@@ -386,13 +414,172 @@ class S3Gateway:
         # can never serve — they just age out of the LRU.
         from ..cache import global_chunk_cache
 
-        ckey = f"s3:{path}:{_etag(entry)}:{offset}:{length}"
+        etag = _etag(entry)
+        size = entry.attributes.file_size
         cache = global_chunk_cache()
-        data = cache.get(ckey)
-        if data is None:
-            data = self.filer.get_data(path, offset, length)
-            cache.put(ckey, data)
-        return data
+        full_key = f"s3:{path}:{etag}:full"
+        if offset == 0 and length is None:
+            data = cache.get(full_key)
+            if data is None:
+                data = self.filer.get_data(path)
+                cache.put(full_key, data)
+            return data
+        end = min(offset + (size - offset if length is None
+                            else length), size)
+        if end <= offset:
+            return b""
+        # A resident full object serves any range by slicing.
+        full = cache.get(full_key)
+        if full is not None:
+            return full[offset:end]
+        return self._ranged_read(cache, path, etag, size, offset,
+                                 end - offset)
+
+    def _block_key(self, path: str, etag: str, idx: int) -> str:
+        return f"s3:{path}:{etag}:blk:{idx}"
+
+    def _ranged_read(self, cache, path: str, etag: str, size: int,
+                     offset: int, length: int) -> bytes:
+        """Block-aligned read-through for ranged GETs, with sequential
+        read-ahead: a confirmed-sequential stream of ranges prefetches
+        upcoming blocks into the chunk cache off-thread."""
+        bs = self.RANGE_BLOCK
+        end = offset + length
+        first, last = offset // bs, (end - 1) // bs
+        out = bytearray(length)
+        b = first
+        while b <= last:
+            bkey = self._block_key(path, etag, b)
+            blk = cache.get(bkey)
+            if blk is None:
+                # a prefetch already has this block on the wire: wait
+                # for it instead of issuing a duplicate fetch
+                blk = self._await_inflight(cache, bkey)
+            if blk is not None:
+                with self._ra_lock:
+                    if bkey in self._ra_prefetched:
+                        self._ra_prefetched.discard(bkey)
+                        readahead_mod.note_hit()
+                lo = max(offset, b * bs)
+                hi = min(end, b * bs + len(blk))
+                if lo < hi:
+                    out[lo - offset:hi - offset] = \
+                        blk[lo - b * bs:hi - b * bs]
+                b += 1
+                continue
+            # contiguous run of blocks neither cached nor in flight,
+            # fetched in ONE filer read
+            run_end = b + 1
+            while run_end <= last:
+                k = self._block_key(path, etag, run_end)
+                if cache.get(k) is not None:
+                    break
+                with self._ra_lock:
+                    if k in self._ra_inflight:
+                        break
+                run_end += 1
+            blob = self.filer.get_data(
+                path, b * bs, min(run_end * bs, size) - b * bs)
+            for i in range(b, run_end):
+                cache.put(self._block_key(path, etag, i),
+                          blob[(i - b) * bs:(i - b + 1) * bs])
+            lo = max(offset, b * bs)
+            hi = min(end, b * bs + len(blob))
+            if lo < hi:
+                out[lo - offset:hi - offset] = \
+                    blob[lo - b * bs:hi - b * bs]
+            b = run_end
+        self._observe_stream(cache, path, etag, size, offset, length)
+        return bytes(out)
+
+    #: A foreground read waits at most this long on an in-flight
+    #: prefetch of the block it needs before fetching it itself (the
+    #: duplicate fetch is the fallback, not the norm).
+    PREFETCH_WAIT_SECONDS = 30.0
+
+    def _await_inflight(self, cache, bkey: str):
+        with self._ra_lock:
+            ev = self._ra_inflight.get(bkey)
+        if ev is None:
+            return None
+        if not ev.wait(self.PREFETCH_WAIT_SECONDS):
+            return None
+        return cache.get(bkey)
+
+    def _observe_stream(self, cache, path: str, etag: str, size: int,
+                        offset: int, length: int) -> None:
+        stream = f"{path}:{etag}"
+        with self._ra_lock:
+            win = self._ra_windows.get(stream)
+            if win is None:
+                win = readahead_mod.ReadaheadWindow(
+                    unit=self.RANGE_BLOCK,
+                    max_units=self.RANGE_WINDOW_UNITS)
+                self._ra_windows[stream] = win
+                while len(self._ra_windows) > self.RANGE_STREAMS:
+                    _, old = self._ra_windows.popitem(last=False)
+                    old.close()
+            self._ra_windows.move_to_end(stream)
+            plan = win.observe(offset, length, size)
+        if plan is None:
+            return
+        start, nbytes = plan
+        bs = self.RANGE_BLOCK
+
+        def _prefetch() -> None:
+            fetched = 0
+            lo_blk = start // bs
+            hi_blk = (start + nbytes + bs - 1) // bs
+            i = lo_blk
+            while i < hi_blk:
+                if cache.get(self._block_key(path, etag, i)) \
+                        is not None:
+                    i += 1
+                    continue
+                # claim a contiguous run of uncached, unclaimed
+                # blocks, then fetch the whole run in ONE filer read
+                claimed: list[tuple[str, threading.Event]] = []
+                with self._ra_lock:
+                    j = i
+                    while (j < hi_blk
+                           and len(claimed) < self.PREFETCH_RUN_BLOCKS):
+                        k = self._block_key(path, etag, j)
+                        if k in self._ra_inflight:
+                            break
+                        ev = threading.Event()
+                        self._ra_inflight[k] = ev
+                        claimed.append((k, ev))
+                        j += 1
+                if not claimed:
+                    i += 1
+                    continue
+                try:
+                    blob = self.filer.get_data(
+                        path, i * bs, min(j * bs, size) - i * bs)
+                    # publish each block the moment its bytes land so a
+                    # foreground reader waiting on it unblocks without
+                    # waiting for the rest of the run
+                    for n, (k, ev) in enumerate(claimed):
+                        cache.put(k, blob[n * bs:(n + 1) * bs])
+                        with self._ra_lock:
+                            self._ra_prefetched.add(k)
+                            self._ra_inflight.pop(k, None)
+                            while len(self._ra_prefetched) > 4096:
+                                self._ra_prefetched.pop()
+                                readahead_mod.note_wasted()
+                        ev.set()
+                    fetched += len(blob)
+                finally:
+                    with self._ra_lock:
+                        for k, ev in claimed:
+                            self._ra_inflight.pop(k, None)
+                            ev.set()
+                i = j
+            if fetched:
+                readahead_mod.record_prefetch(fetched)
+
+        readahead_mod.shared_prefetcher().submit(
+            ("s3", path, etag, start), _prefetch)
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._require_bucket(bucket)
@@ -683,7 +870,7 @@ def _make_handler(gw: S3Gateway):
                     entry = gw.get_object_entry(bucket, key)
                     size = entry.attributes.file_size
                     offset, length = 0, None
-                    status, extra = 200, {}
+                    status, extra = 200, {"Accept-Ranges": "bytes"}
                     parsed = _parse_s3_range(
                         self.headers.get("Range"), size)
                     if parsed is not None:
@@ -728,6 +915,7 @@ def _make_handler(gw: S3Gateway):
                            or "application/octet-stream",
                            {"Content-Length":
                             str(entry.attributes.file_size),
+                            "Accept-Ranges": "bytes",
                             "ETag": f'"{_etag(entry)}"'})
             except Exception as e:
                 err = True

@@ -61,17 +61,22 @@ class FileHandle:
             if end <= offset:
                 return b""
             buf = bytearray(self.read_pages.read(
-                offset, end - offset, self._read_clean))
+                offset, end - offset, self._read_clean,
+                size=self._size))
             self.pages.overlay(offset, buf)
             return bytes(buf)
 
     def _read_clean(self, offset: int, length: int) -> bytes:
         """Flushed-chunk bytes only (no dirty overlay) — the fetch
-        callback behind ``read_pages``."""
+        callback behind ``read_pages``. Also called from the shared
+        prefetch pool, so the chunk-list snapshot takes the handle
+        lock (reentrant from the foreground path); the chunk fetches
+        themselves run unlocked so prefetch never stalls a writer."""
         buf = bytearray(length)
-        chunks = [FileChunk(file_id=c.file_id, offset=c.offset,
-                            size=c.size, mtime_ns=c.mtime_ns)
-                  for c in self.entry.chunks]
+        with self._lock:
+            chunks = [FileChunk(file_id=c.file_id, offset=c.offset,
+                                size=c.size, mtime_ns=c.mtime_ns)
+                      for c in self.entry.chunks]
         from ..filer.filechunks import read_plan
         for piece in read_plan(chunks, offset, length):
             blob = self.wfs._fetch_chunk(piece.file_id)
@@ -142,3 +147,4 @@ class FileHandle:
 
     def release(self) -> None:
         self.flush()
+        self.read_pages.close()

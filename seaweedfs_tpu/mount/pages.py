@@ -13,13 +13,24 @@ ChunkedFileReader / reader-cache role): a small per-handle cache of
 page-aligned CLEAN file bytes, so a kernel re-reading the same pages —
 the normal FUSE pattern — doesn't re-walk the chunk plan each time.
 Dirty bytes never enter it; writes invalidate the pages they touch.
+
+Sequential scans additionally drive async read-ahead
+(cache/readahead.py): once a handle's reads prove sequential, upcoming
+pages are prefetched through the same ``fetch`` callback on the shared
+prefetch pool, so a streaming reader (dataloader, checkpoint restore
+through the mount) overlaps chunk fetches with consumption. The
+``fetch`` callback therefore MUST be safe to call from another thread
+(file_handle.py snapshots the chunk list under the handle lock).
 """
 
 from __future__ import annotations
 
 import bisect
+import threading
 from collections import OrderedDict
 from typing import Callable, Optional
+
+from ..cache import readahead as _ra
 
 
 class DirtyInterval:
@@ -122,51 +133,107 @@ class ReadPages:
     """
 
     def __init__(self, page_size: int = 128 * 1024,
-                 max_pages: int = 64):
+                 max_pages: int = 64, readahead: bool = True):
         self.page_size = max(4096, int(page_size))
         self.max_pages = max(1, int(max_pages))
         self._pages: OrderedDict[int, bytes] = OrderedDict()
+        # Guards _pages/_prefetched/_window against the prefetch pool;
+        # the handle's own lock is above this one (and the foreground
+        # fetch re-enters it reentrantly — see file_handle.py).
+        self._lock = threading.Lock()
+        self._prefetched: set[int] = set()
+        # The window may never outsize the LRU, or a burst of prefetch
+        # would evict its own unread head.
+        self._window = _ra.ReadaheadWindow(
+            unit=self.page_size,
+            max_units=max(1, self.max_pages // 2)) if readahead else None
+        self.prefetch_hits = 0
+        self.prefetch_wasted = 0
 
     def read(self, offset: int, length: int,
-             fetch: Callable[[int, int], bytes]) -> bytes:
+             fetch: Callable[[int, int], bytes],
+             size: Optional[int] = None) -> bytes:
+        """Serve [offset, offset+length); ``size`` (the file length,
+        when the caller knows it) clamps read-ahead at EOF."""
         if length <= 0:
             return b""
         ps = self.page_size
         first = offset // ps
         last = (offset + length - 1) // ps
         out = bytearray(length)
-        p = first
-        while p <= last:
-            page = self._pages.get(p)
-            if page is None:
-                run_end = p
-                while run_end <= last and run_end not in self._pages:
-                    run_end += 1
-                blob = fetch(p * ps, (run_end - p) * ps)
-                for i in range(p, run_end):
-                    self._put_page(i, bytes(
-                        blob[(i - p) * ps:(i - p + 1) * ps]))
-                # Serve this request from the blob itself, not the LRU:
-                # a run longer than max_pages evicts its own head before
-                # the copy-back would reach it.
-                blob_start = p * ps
-                lo = max(offset, blob_start)
-                hi = min(offset + length, blob_start + len(blob))
-                if lo < hi:
-                    out[lo - offset:hi - offset] = \
-                        blob[lo - blob_start:hi - blob_start]
-                p = run_end
-            else:
-                self._pages.move_to_end(p)
-                self._copy(p, offset, out)
-                p += 1
+        with self._lock:
+            p = first
+            while p <= last:
+                page = self._pages.get(p)
+                if page is None:
+                    run_end = p
+                    while run_end <= last and run_end not in self._pages:
+                        run_end += 1
+                    blob = fetch(p * ps, (run_end - p) * ps)
+                    for i in range(p, run_end):
+                        self._put_page(i, bytes(
+                            blob[(i - p) * ps:(i - p + 1) * ps]))
+                    # Serve this request from the blob itself, not the
+                    # LRU: a run longer than max_pages evicts its own
+                    # head before the copy-back would reach it.
+                    blob_start = p * ps
+                    lo = max(offset, blob_start)
+                    hi = min(offset + length, blob_start + len(blob))
+                    if lo < hi:
+                        out[lo - offset:hi - offset] = \
+                            blob[lo - blob_start:hi - blob_start]
+                    p = run_end
+                else:
+                    if p in self._prefetched:
+                        self._prefetched.discard(p)
+                        self.prefetch_hits += 1
+                        _ra.note_hit()
+                    self._pages.move_to_end(p)
+                    self._copy(p, offset, out)
+                    p += 1
+            plan = self._window.observe(offset, length, size) \
+                if self._window is not None else None
+        if plan is not None:
+            self._issue_prefetch(plan[0], plan[1], fetch)
         return bytes(out)
+
+    def _issue_prefetch(self, start: int, nbytes: int,
+                        fetch: Callable[[int, int], bytes]) -> None:
+        ps = self.page_size
+        # plans are page-aligned (the window's unit is ps); re-align
+        # defensively because the slice-to-page filing below is only
+        # correct from an aligned base
+        base = (start // ps) * ps
+        nbytes += start - base
+        start = base
+
+        def _prefetch() -> None:
+            # fetch OUTSIDE our lock: the callback takes the handle
+            # lock, which foreground readers hold above ours
+            blob = fetch(start, nbytes)
+            _ra.record_prefetch(len(blob))
+            with self._lock:
+                for i in range((len(blob) + ps - 1) // ps):
+                    idx = start // ps + i
+                    if idx not in self._pages:
+                        self._put_page(
+                            idx, bytes(blob[i * ps:(i + 1) * ps]))
+                        self._prefetched.add(idx)
+
+        _ra.shared_prefetcher().submit((id(self), start), _prefetch)
 
     def _put_page(self, idx: int, data: bytes) -> None:
         self._pages[idx] = data
         self._pages.move_to_end(idx)
         while len(self._pages) > self.max_pages:
-            self._pages.popitem(last=False)
+            dead, _ = self._pages.popitem(last=False)
+            self._note_dropped(dead)
+
+    def _note_dropped(self, idx: int) -> None:
+        if idx in self._prefetched:
+            self._prefetched.discard(idx)
+            self.prefetch_wasted += 1
+            _ra.note_wasted()
 
     def _copy(self, idx: int, offset: int, out: bytearray) -> None:
         page = self._pages.get(idx, b"")
@@ -183,16 +250,27 @@ class ReadPages:
         means everything from ``offset`` on."""
         ps = self.page_size
         first = offset // ps
-        if length is None:
-            dead = [i for i in self._pages if i >= first]
-        else:
-            if length <= 0:
-                return
-            last = (offset + length - 1) // ps
-            dead = [i for i in self._pages if first <= i <= last]
-        for i in dead:
-            del self._pages[i]
+        with self._lock:
+            if length is None:
+                dead = [i for i in self._pages if i >= first]
+            else:
+                if length <= 0:
+                    return
+                last = (offset + length - 1) // ps
+                dead = [i for i in self._pages if first <= i <= last]
+            for i in dead:
+                del self._pages[i]
+                self._note_dropped(i)
+
+    def close(self) -> None:
+        """Handle released: close the window, count unread prefetch."""
+        with self._lock:
+            if self._window is not None:
+                self._window.close()
+            for idx in list(self._prefetched):
+                self._note_dropped(idx)
 
     @property
     def cached_pages(self) -> int:
-        return len(self._pages)
+        with self._lock:
+            return len(self._pages)

@@ -409,11 +409,25 @@ def cmd_cache_status(env: CommandEnv, argv: list[str]) -> None:
     if "disk_entries" in st:
         env.println(f"  disk: {st['disk_entries']} entries "
                     f"{st['disk_bytes']}/{st['disk_capacity']} bytes")
+        env.println(f"  compaction: "
+                    f"{'on' if st['disk_compaction'] else 'off'} "
+                    f"segments={st['compactions']} "
+                    f"bytes_copied={st['compaction_bytes_copied']} "
+                    f"bytes_dropped={st['compaction_bytes_dropped']}")
     else:
         env.println("  disk: tier disabled")
     env.println(f"  evictions={st['evictions']} "
                 f"admission_rejects={st['admission_rejects']} "
                 f"ttl_seconds={st['ttl_seconds']}")
+    from ..cache import readahead
+    ra = readahead.stats()
+    env.println(f"  readahead: windows_open={ra['windows_open']} "
+                f"opened={ra['windows_opened']} "
+                f"prefetch={ra['prefetch_issued']} "
+                f"({ra['prefetch_bytes']} bytes) "
+                f"hits={ra['prefetch_hits']} "
+                f"wasted={ra['prefetch_wasted']} "
+                f"dropped={ra['prefetch_dropped']}")
     per_vol = global_chunk_cache().per_volume_counts()
     if per_vol:
         def ratio(c: dict) -> float:
@@ -444,6 +458,101 @@ def cmd_cache_clear(env: CommandEnv, argv: list[str]) -> None:
     dropped = st["memory_entries"] + st.get("disk_entries", 0)
     cache.clear()
     env.println(f"cache.clear: dropped {dropped} entries")
+
+
+def _ckpt_store(gateway: str, bucket: str):
+    from ..ckpt import CheckpointStore
+    if not gateway:
+        raise ShellError("ckpt.*: -gateway host:port is required")
+    return CheckpointStore(gateway, bucket=bucket)
+
+
+@command("ckpt.save")
+def cmd_ckpt_save(env: CommandEnv, argv: list[str]) -> None:
+    """Save a seeded synthetic sharded pytree through the S3 gateway —
+    the operator-facing probe of the checkpoint plane (a real training
+    job calls CheckpointStore.save on its own params)."""
+    p = _parser("ckpt.save")
+    p.add_argument("-gateway", default="", help="S3 gateway host:port")
+    p.add_argument("-bucket", default="ckpt")
+    p.add_argument("-name", required=True)
+    p.add_argument("-mesh", default="",
+                   help="dp,sp device mesh (default: configured)")
+    p.add_argument("-params", type=int, default=2)
+    p.add_argument("-rows", type=int, default=256)
+    p.add_argument("-cols", type=int, default=64)
+    p.add_argument("-seed", type=int, default=0)
+    args = p.parse_args(argv)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    store = _ckpt_store(args.gateway, args.bucket)
+    with _mesh_scope(args.mesh):
+        from ..parallel import mesh as mesh_mod
+        mesh = mesh_mod.configured_mesh() or mesh_mod.make_mesh()
+        sharding = NamedSharding(mesh, PartitionSpec("dp", "sp"))
+        key = jax.random.PRNGKey(args.seed)
+        tree = {}
+        for i in range(args.params):
+            key, sub = jax.random.split(key)
+            tree[f"param{i}"] = jax.random.normal(
+                sub, (args.rows, args.cols))
+        # one placement for the whole pytree (a per-param device_put
+        # loop is the SW704/SW702 anti-pattern this plane exists to
+        # avoid)
+        man = store.save(args.name, jax.device_put(tree, sharding))
+    shards = sum(len(pp.shards) for pp in man.params)
+    nbytes = sum(s.nbytes for pp in man.params for s in pp.shards)
+    env.println(f"ckpt.save {args.name}: {len(man.params)} params "
+                f"{shards} shards {nbytes} bytes "
+                f"-> s3://{args.bucket}")
+
+
+@command("ckpt.restore")
+def cmd_ckpt_restore(env: CommandEnv, argv: list[str]) -> None:
+    """Restore a checkpoint onto the configured mesh; prints per-param
+    geometry and the ranged-read profile (each process reads only its
+    own shards' byte ranges)."""
+    p = _parser("ckpt.restore")
+    p.add_argument("-gateway", default="", help="S3 gateway host:port")
+    p.add_argument("-bucket", default="ckpt")
+    p.add_argument("-name", required=True)
+    p.add_argument("-mesh", default="",
+                   help="dp,sp device mesh (default: configured)")
+    args = p.parse_args(argv)
+    from ..ckpt import CheckpointError, ManifestError
+
+    store = _ckpt_store(args.gateway, args.bucket)
+    try:
+        with _mesh_scope(args.mesh):
+            arrays = store.restore(args.name)
+    except (CheckpointError, ManifestError) as e:
+        raise ShellError(str(e)) from e
+    for name in sorted(arrays):
+        a = arrays[name]
+        env.println(f"  {name}: {a.dtype}{list(a.shape)} "
+                    f"spec={a.sharding.spec}")
+    st = store.client.stats
+    env.println(f"ckpt.restore {args.name}: {len(arrays)} params, "
+                f"{st['ranged_gets']} ranged reads "
+                f"{st['bytes_in']} bytes in")
+
+
+@command("ckpt.list")
+def cmd_ckpt_list(env: CommandEnv, argv: list[str]) -> None:
+    """Committed checkpoints visible on the gateway (uncommitted saves
+    have no manifest and are invisible, same as restore's view)."""
+    p = _parser("ckpt.list")
+    p.add_argument("-gateway", default="", help="S3 gateway host:port")
+    p.add_argument("-bucket", default="ckpt")
+    args = p.parse_args(argv)
+    store = _ckpt_store(args.gateway, args.bucket)
+    rows = store.list_checkpoints()
+    for r in rows:
+        env.println(f"  {r['name']}: params={r['params']} "
+                    f"shards={r['shards']} bytes={r['bytes']}")
+    env.println(f"ckpt.list: {len(rows)} checkpoint(s) in "
+                f"s3://{args.bucket}")
 
 
 @command("pipeline.status")

@@ -59,6 +59,34 @@ from . import glog, stats, tracing
 
 DEADLINE_HEADER = "X-Seaweed-Deadline"
 
+
+def parse_range(header, size: int):
+    """RFC 7233 single-range parse: (offset, length) or None to serve
+    the full body with 200 (unknown units and malformed values are
+    ignored, suffix ranges bytes=-N mean the LAST N bytes). Shared by
+    the filer, volume-server and S3 read paths so every tier slices a
+    ``bytes=a-b`` identically."""
+    if not header or not header.startswith("bytes="):
+        return None
+    spec = header[6:].split(",")[0].strip()
+    lo, sep, hi = spec.partition("-")
+    if not sep:
+        return None
+    try:
+        if not lo:  # suffix: last N bytes
+            n = int(hi)
+            if n <= 0:
+                return None
+            offset = max(0, size - n)
+            return offset, size - offset
+        offset = int(lo)
+        stop = int(hi) + 1 if hi else size
+    except ValueError:
+        return None
+    if offset >= size:
+        return None
+    return offset, max(0, min(stop, size) - offset)
+
 #: Ingress metrics (``seaweed_ingress_shed_total{reason,class}``,
 #: ``seaweed_ingress_requests_total`` ...). Servers append
 #: ``METRICS.render()`` to their ``/metrics`` output.

@@ -290,6 +290,55 @@ def test_sw702_device_put_in_loop():
     assert fs and fs[0].severity == "warning"
 
 
+def test_sw704_loop_invariant_data_per_device():
+    fs = only(lint("""
+        import jax
+
+        def broadcast(x, devices):
+            for d in devices:
+                jax.device_put(x, d)
+    """), "SW704")
+    assert fs and fs[0].severity == "warning"
+    assert "NamedSharding" in fs[0].message
+
+
+def test_sw704_sharding_kwarg_in_comprehension():
+    fs = only(lint("""
+        import jax
+
+        def broadcast(x, shardings):
+            return [jax.device_put(x, device=s) for s in shardings]
+    """), "SW704")
+    assert fs and fs[0].severity == "warning"
+
+
+def test_sw704_per_shard_transfer_is_clean():
+    # distilled from ckpt/store.py restore: distinct blocks onto
+    # distinct devices is a legitimate per-shard transfer — neither
+    # SW702 nor SW704 applies
+    fs = lint("""
+        import jax
+
+        def restore(blocks, devices):
+            out = []
+            for blk, d in zip(blocks, devices):
+                out.append(jax.device_put(blk, d))
+            return out
+    """)
+    assert not only(fs, "SW704") and not only(fs, "SW702")
+
+
+def test_sw702_still_fires_without_device_arg():
+    fs = lint("""
+        import jax
+
+        def g(batches):
+            for b in batches:
+                jax.device_put(b)
+    """)
+    assert only(fs, "SW702") and not only(fs, "SW704")
+
+
 def test_sw703_unhashable_static_arg():
     fs = only(lint("""
         import jax

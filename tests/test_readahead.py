@@ -1,0 +1,302 @@
+"""Sequential read-ahead + disk-tier hot-forward compaction units.
+
+ReadaheadWindow is pure bookkeeping, so its ramp (confirm -> open ->
+double -> clamp -> seek reset) is asserted exactly. The DiskTier tests
+drive real segment rotation and prove the ISSUE's claim: a record that
+keeps taking hits survives rotation with compaction on and dies with
+it off, and copied records lose their heat so they cannot ride forward
+forever.
+"""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cache import readahead
+from seaweedfs_tpu.cache.disk_tier import DiskTier
+from seaweedfs_tpu.cache.readahead import Prefetcher, ReadaheadWindow
+from seaweedfs_tpu.mount.pages import ReadPages
+
+UNIT = 1024
+
+
+def _win(**kw):
+    kw.setdefault("unit", UNIT)
+    kw.setdefault("initial_units", 2)
+    kw.setdefault("max_units", 8)
+    kw.setdefault("confirm", 2)
+    return ReadaheadWindow(**kw)
+
+
+# ---------------------------------------------------------------------------
+# ReadaheadWindow
+# ---------------------------------------------------------------------------
+
+def test_window_needs_confirmation_before_opening():
+    w = _win()
+    assert w.observe(0, UNIT) is None          # first read: baseline
+    assert w.observe(UNIT, UNIT) is None       # streak 1 < confirm
+    plan = w.observe(2 * UNIT, UNIT)           # streak 2: opens
+    assert plan is not None and w.is_open
+    start, nbytes = plan
+    assert start == 3 * UNIT
+    assert nbytes == 2 * UNIT                  # initial_units
+
+
+def test_window_doubles_as_reader_catches_up():
+    w = _win()
+    w.observe(0, UNIT)
+    w.observe(UNIT, UNIT)
+    w.observe(2 * UNIT, UNIT)
+    seen = [w.window_units]
+    off = 3 * UNIT
+    for _ in range(12):
+        w.observe(off, UNIT)
+        off += UNIT
+        seen.append(w.window_units)
+    assert seen[0] == 2
+    assert max(seen) == 8                      # clamped at max_units
+    assert sorted(set(seen)) == [2, 4, 8]      # doubling ramp
+
+
+def test_window_seek_resets_streak():
+    w = _win()
+    w.observe(0, UNIT)
+    w.observe(UNIT, UNIT)
+    assert w.observe(2 * UNIT, UNIT) is not None
+    assert w.observe(100 * UNIT, UNIT) is None  # seek: collapse
+    assert not w.is_open
+    assert w.observe(101 * UNIT, UNIT) is None  # must re-prove
+    assert w.observe(102 * UNIT, UNIT) is not None
+
+
+def test_window_tolerates_tail_page_rereads():
+    # a partial tail-page re-read (off by < unit) must not break the
+    # streak — page-aligned consumers do this constantly
+    w = _win()
+    w.observe(0, UNIT)
+    w.observe(UNIT, UNIT // 2)
+    assert w.observe(UNIT + UNIT // 2, UNIT) is not None
+
+
+def test_window_clamps_at_eof():
+    w = _win()
+    size = 4 * UNIT
+    w.observe(0, UNIT, size)
+    w.observe(UNIT, UNIT, size)
+    plan = w.observe(2 * UNIT, UNIT, size)
+    assert plan is not None
+    start, nbytes = plan
+    assert start + nbytes <= size
+    # fully prefetched to EOF: nothing more to plan
+    assert w.observe(3 * UNIT, UNIT, size) is None
+
+
+def test_window_never_replans_prefetched_spans():
+    w = _win()
+    w.observe(0, UNIT)
+    w.observe(UNIT, UNIT)
+    s1, n1 = w.observe(2 * UNIT, UNIT)
+    plan2 = w.observe(3 * UNIT, UNIT)
+    if plan2 is not None:
+        assert plan2[0] >= s1 + n1
+
+
+def test_window_open_count_tracks_close():
+    before = readahead.stats()["windows_open"]
+    w = _win()
+    w.observe(0, UNIT)
+    w.observe(UNIT, UNIT)
+    w.observe(2 * UNIT, UNIT)
+    assert readahead.stats()["windows_open"] == before + 1
+    w.close()
+    assert readahead.stats()["windows_open"] == before
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_runs_and_dedupes():
+    p = Prefetcher(workers=1, depth=8)
+    ran = []
+    gate = threading.Event()
+    done = threading.Event()
+
+    def slow():
+        gate.wait(5)
+        ran.append("slow")
+
+    def fast():
+        ran.append("fast")
+        done.set()
+
+    assert p.submit("k1", slow)
+    assert not p.submit("k1", fast)   # deduped while in flight
+    assert p.submit("k2", fast)
+    gate.set()
+    assert done.wait(5)
+    for _ in range(100):
+        if p.pending() == 0:
+            break
+        time.sleep(0.01)
+    assert "slow" in ran and "fast" in ran
+    assert p.submit("k1", fast)       # key free again after run
+
+
+def test_prefetcher_sheds_when_saturated():
+    p = Prefetcher(workers=1, depth=1)
+    gate = threading.Event()
+    before = readahead.stats()["prefetch_dropped"]
+    # first submit occupies the single worker; fill the queue behind it
+    assert p.submit("a", gate.wait)
+    deadline = time.time() + 5
+    accepted = 0
+    i = 0
+    dropped = False
+    while time.time() < deadline and not dropped:
+        i += 1
+        if p.submit(f"b{i}", lambda: None):
+            accepted += 1
+        else:
+            dropped = True
+    gate.set()
+    assert dropped, "saturated queue must shed, not block"
+    assert readahead.stats()["prefetch_dropped"] > before
+
+
+# ---------------------------------------------------------------------------
+# ReadPages integration: sequential reads trigger prefetch hits
+# ---------------------------------------------------------------------------
+
+def test_read_pages_sequential_prefetch_hits():
+    page = 1024
+    size = 256 * page
+    blob = bytes(range(256)) * (size // 256)
+    fetched = []
+
+    def fetch(off, ln):
+        fetched.append((off, ln))
+        time.sleep(0.002)   # real fetches have latency worth hiding
+        return blob[off:off + ln]
+
+    rp = ReadPages(page_size=page, max_pages=64)
+    # enough sequential reads to confirm the stream and open the window
+    for off in range(0, 8 * page, page):
+        assert rp.read(off, page, fetch, size=size) == \
+            blob[off:off + page]
+    # wait for the prefetcher to land a page ahead of the reader (a
+    # busy host can starve the pool for a while, so poll rather than
+    # racing the whole scan against it), then read exactly that page:
+    # it must count as a hit AND carry the right bytes
+    pidx = None
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with rp._lock:
+            if rp._prefetched:
+                pidx = min(rp._prefetched)
+                break
+        time.sleep(0.005)
+    assert pidx is not None, "prefetcher never landed a page"
+    ps = rp.page_size
+    assert rp.read(pidx * ps, ps, fetch, size=size) == \
+        blob[pidx * ps:(pidx + 1) * ps]
+    assert rp.prefetch_hits > 0
+    rp.close()
+
+
+def test_read_pages_random_reads_stay_quiet():
+    page = 1024
+    size = 64 * page
+    blob = b"z" * size
+    rp = ReadPages(page_size=page, max_pages=16)
+    for off in (0, 30 * page, 5 * page, 60 * page, 12 * page):
+        rp.read(off, page, lambda o, n: blob[o:o + n], size=size)
+    time.sleep(0.05)
+    assert rp.prefetch_hits == 0
+    rp.close()
+
+
+# ---------------------------------------------------------------------------
+# DiskTier hot-forward compaction
+# ---------------------------------------------------------------------------
+
+def _get(tier, key):
+    hit = tier.get(key)
+    return None if hit is None else hit[0]
+
+
+def _fill_until_rotation(tier, start, payload, count):
+    for i in range(start, start + count):
+        tier.put(f"cold-{i}", payload)
+    return start + count
+
+
+@pytest.mark.parametrize("compaction", [True, False])
+def test_hot_record_survival_depends_on_compaction(tmp_path,
+                                                   compaction):
+    payload = b"x" * 4096
+    tier = DiskTier(tmp_path / f"dt-{compaction}",
+                    capacity_bytes=16 * 4096 * 4, segments=4,
+                    compaction=compaction)
+    tier.put("hot", payload)
+    nxt = 0
+    for _ in range(3):
+        hit = _get(tier, "hot")               # keep taking hits
+        if compaction:
+            assert hit == payload
+        nxt = _fill_until_rotation(tier, nxt, payload, 30)
+    if compaction:
+        assert _get(tier, "hot") == payload
+        assert tier.compactions > 0
+        assert tier.compaction_bytes_copied > 0
+    else:
+        assert _get(tier, "hot") is None
+        assert tier.compactions == 0
+    tier.close()
+
+
+def test_unhit_record_is_not_copied_forward(tmp_path):
+    payload = b"y" * 4096
+    tier = DiskTier(tmp_path / "dt", capacity_bytes=16 * 4096 * 4,
+                    segments=4, compaction=True)
+    tier.put("never-read", payload)
+    for i in range(120):
+        tier.put(f"cold-{i}", payload)
+    assert _get(tier, "never-read") is None
+    tier.close()
+
+
+def test_compacted_heat_resets(tmp_path):
+    # hit once, survive ONE rotation sweep, then (unhit) die on the
+    # next — copied records must not ride forward forever
+    payload = b"h" * 4096
+    tier = DiskTier(tmp_path / "dt", capacity_bytes=16 * 4096 * 4,
+                    segments=4, compaction=True)
+    tier.put("hot", payload)
+    assert _get(tier, "hot") == payload
+    nxt = _fill_until_rotation(tier, 0, payload, 30)
+    assert _get(tier, "hot") == payload       # survived, and re-warmed
+    # enough puts to rotate through every segment at least twice:
+    # first visit copies hot forward (warm) resetting its heat, the
+    # next visit finds it unhit and drops it
+    for _ in range(4):
+        nxt = _fill_until_rotation(tier, nxt, payload, 30)
+    assert _get(tier, "hot") is None
+    tier.close()
+
+
+def test_compacted_records_survive_restart(tmp_path):
+    payload = b"r" * 4096
+    tier = DiskTier(tmp_path / "dt", capacity_bytes=16 * 4096 * 4,
+                    segments=4, compaction=True)
+    tier.put("hot", payload)
+    assert _get(tier, "hot") == payload
+    _fill_until_rotation(tier, 0, payload, 30)
+    assert _get(tier, "hot") == payload
+    tier.close()
+    re = DiskTier(tmp_path / "dt", capacity_bytes=16 * 4096 * 4,
+                  segments=4, compaction=True)
+    assert _get(re, "hot") == payload
+    re.close()
