@@ -127,6 +127,27 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# Flight-recorder smoke (docs/pipeline.md "Flight recorder"): an
+# armed-recorder encode must stay byte-identical to a recorder-off
+# encode, pipeline.analyze must produce a bottleneck verdict, and the
+# exported Chrome trace must parse with duration + counter events.
+bash scripts/flight_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: flight_smoke failed (exit $rc) — the pipeline" \
+         "flight recorder perturbed output or broke its analyze/" \
+         "trace surface; see scripts/flight_smoke.sh" >&2
+    exit "$rc"
+fi
+
+# Bench drift report (ADVISORY — never fails the gate): diff the two
+# newest banked BENCH_r*.json rounds so a silent throughput slide is
+# visible in every lint run. scripts/bench_diff.py exits nonzero on a
+# >10% same-platform headline regression, but correctness gating is
+# this script's job, not throughput gating — hence `|| true`.
+python scripts/bench_diff.py || true
+
 # Simulation smoke (docs/simulation.md): 200 simulated volume servers
 # drive one real master through a traffic-shift and a rack-loss wave
 # on a virtual clock; every convergence invariant must hold and the

@@ -29,6 +29,7 @@ from ..cache import ChunkCache
 from ..pb import master_pb2, volume_server_pb2
 from ..pipeline import decode as decode_mod
 from ..pipeline import encode as encode_mod
+from ..pipeline import flight as flight_mod
 from ..pipeline import rebuild as rebuild_mod
 from ..pipeline.read import EcVolumeReader
 from ..pipeline.scheme import DEFAULT_SCHEME, EcScheme
@@ -999,6 +1000,7 @@ def _make_http_handler(vs: VolumeServer):
                 self._send(200, (vs.metrics.render()
                                  + tracing.METRICS.render()
                                  + retry.METRICS.render()
+                                 + flight_mod.METRICS.render()
                                  + httpserver.METRICS.render()).encode(),
                            EXPOSITION_CONTENT_TYPE)
                 return
@@ -1251,6 +1253,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     profiler.ensure_started()
     from ..pipeline import pipe as pipe_mod
     pipe_mod.configure_from(conf)
+    flight_mod.configure_from(conf)
     if config_mod.lookup(conf, "mesh") is not None:
         # parallel/mesh imports jax; a volume server without a [mesh]
         # section must not pay that at every spawn

@@ -522,7 +522,9 @@ def prepare_batch(batch: np.ndarray, mesh=None) -> Prepared:
     placement time lands in the ``pipe.compute.dispatch`` stage, which
     is what ``[pipeline] double_buffer`` overlaps with the previous
     batch's collective."""
+    from ..pipeline import flight
     t0 = time.perf_counter()
+    flight.record(flight.EV_H2D_SUBMIT)
     b, n_in, s = batch.shape
     if mesh is None or mesh is AUTO:
         mesh = _auto_mesh_for(b)
@@ -537,6 +539,9 @@ def prepare_batch(batch: np.ndarray, mesh=None) -> Prepared:
         batch = padded
     arr = shard_batch(batch, mesh)
     _observe("dispatch", time.perf_counter() - t0, batch.nbytes, mesh)
+    # READY means the async device_put is ISSUED (transfer in flight),
+    # not landed — the landing is observed by the batch's sync span.
+    flight.record(flight.EV_H2D_READY, arg=batch.nbytes)
     return Prepared(arr, b, s, mesh)
 
 

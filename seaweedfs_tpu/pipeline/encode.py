@@ -31,7 +31,7 @@ import numpy as np
 
 from ..storage import ec_files, idx as idx_mod, volume as volume_mod
 from ..storage import superblock as superblock_mod
-from . import pipe, writeback
+from . import flight, pipe, writeback
 from .scheme import DEFAULT_SCHEME, EcScheme
 
 #: Default bound on bytes striped into one device batch (input side);
@@ -227,6 +227,7 @@ def write_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
 
         def batches():
             for plan in plans:
+                flight.record(flight.EV_ENQUEUE, arg=plan.nbytes)
                 buf = pool.acquire()
                 view = buf[:plan.nbytes]
                 for boff, foff, want, have in plan.segs:

@@ -47,6 +47,7 @@ from typing import Any, Callable, Iterable, Optional
 import numpy as np
 
 from ..util import bufcheck
+from . import flight
 
 # Arm the runtime pooled-buffer checker straight from the environment
 # so `SEAWEED_BUFCHECK=1 python -m ...` works for any pipeline process
@@ -54,6 +55,11 @@ from ..util import bufcheck
 # where conftest installs it. No-op (and zero per-call cost) when the
 # variable is unset.
 bufcheck.install_from_env()
+
+# Same deal for the flight recorder: SEAWEED_FLIGHT=1 arms per-batch
+# lifecycle recording (scripts/flight_smoke.sh); unset means every
+# flight.record() below is one attribute load + None test.
+flight.install_from_env()
 
 #: Stage-queue depth: 2 = classic double buffering (config default).
 DEPTH = 2
@@ -184,15 +190,22 @@ class HostBufferPool:
     def acquire(self, timeout: Optional[float] = None) -> np.ndarray:
         """A free (nbytes,) uint8 buffer; blocks until one is
         recycled. Raises ``queue.Empty`` on timeout."""
+        flight.record(flight.EV_POOL_WAIT)
         buf = self._free.get(timeout=timeout) if timeout is not None \
             else self._free.get()
         bufcheck.on_acquire(buf)
+        occ = self.in_flight()
+        flight.record(flight.EV_POOL_GOT, value=float(occ))
+        flight.record(flight.EV_POOL_OCC, value=float(occ))
         return buf
 
     def release(self, buf: np.ndarray) -> None:
         """Return a buffer obtained from :meth:`acquire`."""
         bufcheck.on_release(buf)
         self._free.put(buf)
+        occ = self.in_flight()
+        flight.record(flight.EV_RECYCLE, value=float(occ))
+        flight.record(flight.EV_POOL_OCC, value=float(occ))
 
     def in_flight(self) -> int:
         return self.count - self._free.qsize()
@@ -471,6 +484,7 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
     if grouping and controller is None and cfg.feedback:
         controller = GroupController(group)
     t_wall = time.perf_counter()
+    flight.record(flight.EV_RUN_START, arg=hash(kind) & 0x7FFFFFFF)
     try:
         if not overlapped:
             n = _run_sync(batches, encode_fn, write_fn, recycle_fn, st,
@@ -484,8 +498,17 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
                                 prepare_fn is not None)
     finally:
         st.wall_seconds = time.perf_counter() - t_wall
+        flight.record(flight.EV_RUN_END)
         if publish:
             publish_stats(st, kind=kind)
+        if flight.armed():
+            # end-of-run fold into the seaweed_pipeline_* gauges and
+            # the /debug/vars "flight" verdict — never on the hot path,
+            # and never allowed to fail the run it observed
+            try:
+                flight.publish_run_gauges()
+            except Exception:  # seaweedlint: disable=SW301 — observability must not fail the observed run
+                pass
     return n
 
 
@@ -502,6 +525,8 @@ def _run_sync(batches, encode_fn, write_fn, recycle_fn,
     n = 0
     it = iter(batches)
     while True:
+        seq = st.batches
+        flight.record(flight.EV_READ_START, batch=seq)
         t0 = time.perf_counter()
         try:
             item = next(it)
@@ -510,17 +535,26 @@ def _run_sync(batches, encode_fn, write_fn, recycle_fn,
         t1 = time.perf_counter()
         st.read_seconds += t1 - t0
         meta, batch = item
+        flight.record(flight.EV_READ_END, batch=seq,
+                      arg=_batch_nbytes(batch))
+        flight.record(flight.EV_DISPATCH, batch=seq)
         result = encode_fn(batch if prepare_fn is None
                            else prepare_fn(batch))
         t2 = time.perf_counter()
         st.dispatch_seconds += t2 - t1
+        flight.record(flight.EV_DISPATCH_DONE, batch=seq, arg=1)
+        flight.record(flight.EV_SYNC_START, batch=seq)
         result_np = np.asarray(result)
         t3 = time.perf_counter()
         st.sync_seconds += t3 - t2
+        flight.record(flight.EV_SYNC_END, batch=seq,
+                      arg=result_np.nbytes)
+        flight.record(flight.EV_WRITE_START, batch=seq)
         write_fn(meta, batch, result_np)
         if recycle_fn is not None:
             recycle_fn(meta, batch)
         st.write_seconds += time.perf_counter() - t3
+        flight.record(flight.EV_WRITE_END, batch=seq)
         st.batches += 1
         st.groups += 1
         st.max_group = max(st.max_group, 1)
@@ -542,9 +576,16 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
     stop = threading.Event()
 
     def reader():
+        # Per-stage local batch sequence: every queue between stages is
+        # FIFO and grouping/lookahead preserve order, so the reader's
+        # n-th batch IS the compute stage's n-th and the writer's n-th
+        # — independent counters per stage align per batch without
+        # widening the queue tuples.
+        seq = 0
         try:
             it = iter(batches)
             while True:
+                flight.record(flight.EV_READ_START, batch=seq)
                 t0 = time.perf_counter()
                 try:
                     item = next(it)
@@ -552,6 +593,9 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                     return
                 dt = time.perf_counter() - t0
                 st.read_seconds += dt
+                flight.record(flight.EV_READ_END, batch=seq,
+                              arg=_batch_nbytes(item[1]))
+                seq += 1
                 _stage_observe("pipe.read", dt,
                                _batch_nbytes(item[1]))
                 if controller is not None:
@@ -559,29 +603,40 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                 if stop.is_set():
                     return
                 read_q.put(item)
+                flight.record(flight.EV_QDEPTH,
+                              value=float(read_q.qsize()), arg=0)
         except BaseException as e:  # noqa: BLE001 — re-raised in main
             errors.append(e)
         finally:
             read_q.put(_END)
 
     def writer():
+        seq = 0
         try:
             while True:
                 item = write_q.get()
                 if item is _END:
                     return
+                flight.record(flight.EV_QDEPTH,
+                              value=float(write_q.qsize()), arg=1)
                 meta, batch, result, disp_share = item
+                flight.record(flight.EV_SYNC_START, batch=seq)
                 t0 = time.perf_counter()
                 result_np = np.asarray(result)
                 t1 = time.perf_counter()
                 st.sync_seconds += t1 - t0
+                flight.record(flight.EV_SYNC_END, batch=seq,
+                              arg=result_np.nbytes)
                 _stage_observe("pipe.compute", disp_share + (t1 - t0),
                                result_np.nbytes)
+                flight.record(flight.EV_WRITE_START, batch=seq)
                 write_fn(meta, batch, result_np)
                 if recycle_fn is not None:
                     recycle_fn(meta, batch)
                 dt = time.perf_counter() - t1
                 st.write_seconds += dt
+                flight.record(flight.EV_WRITE_END, batch=seq)
+                seq += 1
                 _stage_observe("pipe.write", dt)
                 st.batches += 1
                 st.bytes_in += _batch_nbytes(batch)
@@ -607,6 +662,9 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
     rt.start()
     wt.start()
     n = 0
+    #: compute-stage batch sequence (see reader() note: FIFO order
+    #: makes per-stage counters line up per batch)
+    cseq = 0
     #: double-buffer lookahead ([pipeline] double_buffer): the one
     #: (meta, batch, prepared) whose H2D transfer is in flight while
     #: the previous batch computes; flushed after the loop.
@@ -663,6 +721,7 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                         st.dispatch_seconds += time.perf_counter() - t0
                         continue
                     meta, batch, payload = prev
+                flight.record(flight.EV_DISPATCH, batch=cseq)
                 try:
                     result = encode_fn(payload)
                 except BaseException as e:  # noqa: BLE001 — see _fail
@@ -676,9 +735,14 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                     break
                 dt = time.perf_counter() - t0
                 st.dispatch_seconds += dt
+                flight.record(flight.EV_DISPATCH_DONE, batch=cseq,
+                              arg=1)
+                cseq += 1
                 st.groups += 1
                 st.max_group = max(st.max_group, 1)
                 write_q.put((meta, batch, result, dt))
+                flight.record(flight.EV_QDEPTH,
+                              value=float(write_q.qsize()), arg=1)
                 n += 1
                 continue
             # group drain: whatever is already queued, plus — when the
@@ -710,6 +774,7 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
             if controller is not None and len(items) >= target:
                 controller.note_supplied()
             t0 = time.perf_counter()
+            flight.record(flight.EV_DISPATCH, batch=cseq)
             try:
                 results = encode_multi_fn([b for _, b in items])
             except BaseException as e:  # noqa: BLE001 — as single path
@@ -724,6 +789,9 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                 break
             dt = time.perf_counter() - t0
             st.dispatch_seconds += dt
+            flight.record(flight.EV_DISPATCH_DONE, batch=cseq,
+                          arg=len(items))
+            cseq += len(items)
             st.groups += 1
             st.max_group = max(st.max_group, len(items))
             if controller is not None:
@@ -731,6 +799,8 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
             share = dt / len(items)
             for (meta, batch), result in zip(items, results):
                 write_q.put((meta, batch, result, share))
+                flight.record(flight.EV_QDEPTH,
+                              value=float(write_q.qsize()), arg=1)
             n += len(items)
         # flush the double-buffer tail: the last prepared batch has no
         # successor to overlap with
@@ -745,6 +815,7 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                         pass
             else:
                 t0 = time.perf_counter()
+                flight.record(flight.EV_DISPATCH, batch=cseq)
                 try:
                     result = encode_fn(payload)
                 except BaseException as e:  # noqa: BLE001 — see _fail
@@ -752,6 +823,9 @@ def _run_overlapped(batches, encode_fn, write_fn, depth,
                 else:
                     dt = time.perf_counter() - t0
                     st.dispatch_seconds += dt
+                    flight.record(flight.EV_DISPATCH_DONE,
+                                  batch=cseq, arg=1)
+                    cseq += 1
                     st.groups += 1
                     st.max_group = max(st.max_group, 1)
                     write_q.put((meta, batch, result, dt))

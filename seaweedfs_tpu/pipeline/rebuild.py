@@ -26,7 +26,7 @@ import numpy as np
 
 from ..ops.rs_ref import TooFewShardsError
 from ..storage import ec_files
-from . import pipe, writeback
+from . import flight, pipe, writeback
 from .scheme import DEFAULT_SCHEME, EcScheme
 
 #: Chunk of shard-file bytes processed per device call; the live input
@@ -99,6 +99,7 @@ def rebuild_ec_files(base: str | Path, scheme: EcScheme = DEFAULT_SCHEME,
         pos = 0
         while pos < size:
             take = min(chunk_bytes, size - pos)
+            flight.record(flight.EV_ENQUEUE, arg=k * take)
             buf = pool.acquire()
             view = buf[:k * take]
             for s, fd in enumerate(in_fds):

@@ -29,6 +29,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from ..util import bufcheck
+from . import flight
 
 #: Linux UIO_MAXIOV; one pwritev can scatter at most this many
 #: segments, longer row lists are chunked.
@@ -165,6 +166,8 @@ class WriterPool:
         if fd is None:
             raise WriterError(f"writeback: {path!r} not opened")
         q = self._queues[hash(path) % self.threads]
+        flight.record(flight.EV_WRITE_SUBMIT,
+                      arg=sum(r.nbytes for r in rows))
         # Under SEAWEED_BUFCHECK, remember which pooled slabs (and
         # generations) these rows view, so the worker can detect the
         # slab being recycled while the write is still in flight.
@@ -234,9 +237,12 @@ class WriterPool:
                 # re-check AFTER the write: a recycle that raced the
                 # pwritev corrupted the bytes already on disk
                 bufcheck.verify_rows(tags, where="after pwritev")
+                dt = time.perf_counter() - t0
+                flight.record(flight.EV_PWRITEV_RETIRE, value=dt,
+                              arg=wrote)
                 with self._busy_lock:
                     self.bytes_written += wrote
-                    self.busy_seconds += time.perf_counter() - t0
+                    self.busy_seconds += dt
             except BaseException as e:  # noqa: BLE001 — re-raised at submit/close
                 self._errors.append(e)
             finally:

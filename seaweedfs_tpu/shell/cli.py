@@ -75,6 +75,11 @@ def main(argv: list[str] | None = None) -> int:
         # that when a mesh is actually configured)
         from ..parallel import mesh as mesh_mod
         mesh_mod.configure_from(conf)
+    if config_mod.lookup(conf, "flight") is not None:
+        # [flight] arms the pipeline flight recorder for offline
+        # ec.encode/ec.rebuild runs (pipeline.dump / pipeline.analyze)
+        from ..pipeline import flight as flight_mod
+        flight_mod.configure_from(conf)
 
     if args.master:
         from . import fs_commands  # noqa: F401 — registers fs.* commands

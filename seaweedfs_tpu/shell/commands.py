@@ -589,14 +589,100 @@ def cmd_pipeline_status(env: CommandEnv, argv: list[str]) -> None:
         f"in={pay['bytes_in']}B out={pay['bytes_out']}B "
         f"read={pay['read_seconds']}s compute={pay['compute_seconds']}s "
         f"write={pay['write_seconds']}s wall={pay['wall_seconds']}s")
+
+    def _busy(run: dict) -> str:
+        # busy FRACTION of the run's wall window, not raw
+        # thread-seconds: stage sums add seconds from several threads
+        # (4 writeback workers alone), so sec/sec "utilization" over
+        # 100% used to be printable here and meant nothing
+        wall = run.get("wall") or 0.0
+        if wall <= 0:
+            return "busy=n/a"
+        return ("busy read={:.0%} compute={:.0%} write={:.0%}".format(
+            min(1.0, run["read"] / wall),
+            min(1.0, run["compute"] / wall),
+            min(1.0, run["write"] / wall)))
+
     for run in pay["recent"]:
         env.println(
             f"  {run['kind']}: {run['batches']} batches "
             f"in {run['groups']} dispatches (max group "
             f"{run['max_group']}) {run['bytes_in']}B "
-            f"read={run['read']}s compute={run['compute']}s "
-            f"write={run['write']}s wall={run['wall']}s "
+            f"{_busy(run)} wall={run['wall']}s "
             f"{run.get('gibps', 0)} GiB/s")
+    from ..pipeline import flight
+    fp = flight.debug_payload()
+    last = fp.get("last_run")
+    if last:
+        # recorder-derived occupancy: measured against the recorded
+        # wall window, the honest version of the busy lines above
+        frac = " ".join(f"{k}={v:.0%}"
+                        for k, v in last["busy_fraction"].items())
+        env.println(f"  flight: window={last['window_seconds']}s "
+                    f"batches={last['batches']} {frac}")
+        env.println(f"  flight: {last['verdict']}")
+    elif fp.get("armed"):
+        env.println("  flight: armed, no recorded run yet")
+
+
+@command("pipeline.dump")
+def cmd_pipeline_dump(env: CommandEnv, argv: list[str]) -> None:
+    """Export the flight recorder's window as Chrome trace-event JSON
+    (open in Perfetto or chrome://tracing — one track per stage thread
+    plus queue-depth / pool-occupancy counter tracks)."""
+    p = _parser("pipeline.dump")
+    p.add_argument("-trace", required=True,
+                   help="output path for the trace JSON")
+    args = p.parse_args(argv)
+    from ..pipeline import flight
+    if not flight.armed():
+        raise ShellError(
+            "flight recorder not armed — set [flight] enabled = true "
+            "or SEAWEED_FLIGHT=1 and rerun the pipeline")
+    n = flight.dump_trace(args.trace)
+    env.println(f"pipeline.dump: {n} trace events -> {args.trace} "
+                f"(load in Perfetto / chrome://tracing)")
+
+
+@command("pipeline.analyze")
+def cmd_pipeline_analyze(env: CommandEnv, argv: list[str]) -> None:
+    """Name the recorded window's bottleneck stage and recommend
+    [pipeline] knob changes, with the occupancy evidence printed
+    alongside (docs/pipeline.md)."""
+    p = _parser("pipeline.analyze")
+    p.add_argument("-all", action="store_true",
+                   help="analyze the whole ring, not just the last run")
+    args = p.parse_args(argv)
+    from ..pipeline import flight
+    if not flight.armed():
+        raise ShellError(
+            "flight recorder not armed — set [flight] enabled = true "
+            "or SEAWEED_FLIGHT=1 and rerun the pipeline")
+    ana = flight.analyze(last_run_only=not args.all)
+    if ana["bottleneck"] is None:
+        env.println("pipeline.analyze: no recorded batches")
+        return
+    occ = ana["occupancy"]
+    env.println(f"pipeline.analyze: {ana['verdict']}")
+    env.println(f"  window={occ['window_seconds']}s "
+                f"batches={occ['batches']} events={occ['events']}")
+    for stage in sorted(occ["busy_fraction"],
+                        key=occ["busy_fraction"].get, reverse=True):
+        frac = occ["busy_fraction"][stage]
+        line = f"  {stage}: busy={frac:.1%}"
+        bub = occ["bubble_seconds"].get(stage)
+        if bub is not None:
+            line += f" bubble={bub}s"
+        env.println(line)
+    if occ["waited_on"]:
+        waits = ", ".join(
+            f"{k}={v}" for k, v in sorted(occ["waited_on"].items(),
+                                          key=lambda kv: -kv[1]))
+        env.println(f"  per-batch critical path (batches that waited "
+                    f"longest on each stage): {waits}")
+    env.println("  recommendations:")
+    for rec in ana["recommendations"]:
+        env.println(f"   - {rec}")
 
 
 @command("trace.status")

@@ -154,6 +154,16 @@ overlapped = true                # false = synchronous reference path
 preallocate = true               # size shard files up front
 double_buffer = false            # two-deep H2D lookahead (mesh path)
 """,
+    "flight": """\
+# flight.toml — pipeline flight recorder (docs/pipeline.md).
+# Per-batch lifecycle events (read/H2D/dispatch/D2H/write/recycle)
+# into a bounded preallocated ring; export with `pipeline.dump -trace`
+# and read the verdict with `pipeline.analyze`. SEAWEED_FLIGHT=1 arms
+# it from the environment without a config file.
+[flight]
+enabled = false                  # arm the per-batch event recorder
+capacity = 65536                 # ring slots (oldest events evicted)
+""",
     "mesh": """\
 # mesh.toml — explicit (dp, sp) device mesh for EC compute (docs/mesh.md).
 # Disabled: multi-chip accelerators auto-shard, everything else takes

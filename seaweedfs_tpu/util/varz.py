@@ -37,6 +37,15 @@ def _pipeline_payload() -> dict:
     return mod.debug_payload()
 
 
+def _flight_payload() -> dict:
+    # lazy like the pipeline payload: only meaningful once the flight
+    # recorder module is loaded (any pipeline import pulls it in)
+    mod = sys.modules.get("seaweedfs_tpu.pipeline.flight")
+    if mod is None:
+        return {}
+    return mod.debug_payload()
+
+
 def _mesh_payload() -> dict:
     # lazy like the pipeline payload: parallel/mesh pulls in jax
     mod = sys.modules.get("seaweedfs_tpu.parallel.mesh")
@@ -88,6 +97,7 @@ def payload(component: str, metrics: Optional[Metrics] = None,
         "faults": faults.debug_payload(),
         "profiler": profiler.debug_payload(),
         "pipeline": _pipeline_payload(),
+        "flight": _flight_payload(),
         "mesh": _mesh_payload(),
         "ingress": _ingress_payload(),
         "http_pool": retry.pool().payload(),
