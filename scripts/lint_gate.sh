@@ -16,9 +16,14 @@ cd "$(dirname "$0")/.." || exit 2
 
 # --fail-stale keeps the baseline honest (fixed findings must be
 # pruned, not silently carried); --budget-seconds asserts the whole
-# analysis — interprocedural dataflow included — stays CI-cheap.
+# analysis — interprocedural dataflow included — stays CI-cheap (a
+# warm .seaweedlint_cache.json makes repeat runs near-free; --no-cache
+# here forces the real analysis so the budget actually measures it);
+# --families prints the per-rule-family triage table (new vs
+# baselined vs pragma'd) so a creeping pragma count is visible.
 env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.analysis \
-    --gate warning --fail-stale --stats --budget-seconds 30
+    --gate warning --fail-stale --stats --families --no-cache \
+    --budget-seconds 30
 rc=$?
 if [ "$rc" -ne 0 ]; then
     echo >&2
@@ -36,7 +41,12 @@ fi
 # (util/bufcheck.py): recycled slabs are poisoned and every positioned
 # write re-verifies its source generation, so a pooled view consumed
 # after recycle (the PR 12 race class) fails here deterministically.
-SEAWEED_BUFCHECK=1 bash scripts/pipeline_smoke.sh $((8 * 1024 * 1024))
+# SEAWEED_RACECHECK=raise arms the Eraser lockset race checker
+# (util/racecheck.py) on the same run: pipeline pools, stage stats and
+# controllers intercept attribute writes, and any cross-thread write
+# whose candidate lockset goes empty faults the smoke at the write.
+SEAWEED_BUFCHECK=1 SEAWEED_RACECHECK=raise \
+    bash scripts/pipeline_smoke.sh $((8 * 1024 * 1024))
 rc=$?
 if [ "$rc" -ne 0 ]; then
     echo >&2

@@ -4,7 +4,8 @@
         [--baseline FILE | --no-baseline] [--write-baseline]
         [--prune-baseline] [--fail-stale]
         [--gate error|warning|none] [--format human|json|sarif]
-        [--stats] [--budget-seconds S] [--verbose]
+        [--stats] [--families] [--no-cache]
+        [--budget-seconds S] [--verbose]
 
 Exit codes: 0 clean (or all findings baselined), 1 new findings at or
 above the gate severity (or stale baseline under --fail-stale, or
@@ -19,6 +20,7 @@ import sys
 import time
 from pathlib import Path
 
+from . import cache as result_cache
 from .baseline import (diff_baseline, load_baseline, prune_baseline,
                        write_baseline)
 from .engine import analyze_paths
@@ -43,6 +45,46 @@ def _print_stats(timings: dict[str, float], total: float) -> None:
         share = 100.0 * secs / total if total else 0.0
         print(f"  {label:<{width}}  {secs:7.3f}s  {share:5.1f}%")
     print(f"  {'total':<{width}}  {total:7.3f}s")
+
+
+def _family(rule: str) -> str:
+    # "SW103" -> "SW1xx"; anything oddly shaped keeps its own row
+    return rule[:3] + "xx" if len(rule) == 5 and \
+        rule.startswith("SW") else rule
+
+
+def _family_table(findings: list[Finding], new: list[Finding],
+                  suppressed: list[Finding]) -> list[str]:
+    """Per-rule-family triage table: how many findings are NEW (would
+    gate), how many ride in the baseline, how many an inline pragma
+    deliberately silenced, plus ungated info chatter."""
+    new_ids = {id(f) for f in new}
+    fams: dict[str, list[int]] = {}
+
+    def row(rule):
+        return fams.setdefault(_family(rule), [0, 0, 0, 0])
+
+    for f in findings:
+        if f.severity == "info":
+            row(f.rule)[3] += 1
+        elif id(f) in new_ids:
+            row(f.rule)[0] += 1
+        else:
+            row(f.rule)[1] += 1
+    for f in suppressed:
+        row(f.rule)[2] += 1
+    lines = ["seaweedlint --families: findings by rule family",
+             f"  {'family':<8}{'new':>6}{'baselined':>11}"
+             f"{'pragma-d':>10}{'info':>6}"]
+    total = [0, 0, 0, 0]
+    for fam in sorted(fams):
+        n, b, p, i = fams[fam]
+        total = [total[0] + n, total[1] + b,
+                 total[2] + p, total[3] + i]
+        lines.append(f"  {fam:<8}{n:>6}{b:>11}{p:>10}{i:>6}")
+    lines.append(f"  {'total':<8}{total[0]:>6}{total[1]:>11}"
+                 f"{total[2]:>10}{total[3]:>6}")
+    return lines
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -75,7 +117,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="alias for --format=json")
     ap.add_argument("--stats", action="store_true",
-                    help="print per-rule-family wall time")
+                    help="print per-rule-family wall time and cache "
+                         "hit/miss counts")
+    ap.add_argument("--families", action="store_true",
+                    help="print a per-rule-family triage table "
+                         "(new vs baselined vs pragma'd)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the incremental "
+                         "result cache (.seaweedlint_cache.json)")
     ap.add_argument("--budget-seconds", type=float, default=0.0,
                     help="fail if the analysis run exceeds this many "
                          "seconds (0 = no budget)")
@@ -87,8 +136,31 @@ def main(argv: list[str] | None = None) -> int:
     root = _REPO_ROOT
     paths = args.paths or ["seaweedfs_tpu"]
     timings: dict[str, float] = {}
+    suppressed: list[Finding] = []
     t0 = time.perf_counter()
-    findings = analyze_paths(paths, root, timings)
+    # Incremental cache: reuse the previous run's findings when no
+    # analyzed file (and no rule module) changed — see cache.py for
+    # why reuse is all-or-nothing. The probe itself is just stats.
+    findings = None
+    cache_hits = cache_misses = 0
+    cache_state = "disabled"
+    cache_path = root / result_cache.DEFAULT_CACHE
+    if not args.no_cache:
+        version = result_cache.rules_version()
+        keys = result_cache.file_keys(paths, root)
+        entry, cache_hits, cache_misses = result_cache.load(
+            cache_path, version, keys)
+        if entry is not None:
+            findings, suppressed = entry
+            cache_state = "hit"
+        else:
+            cache_state = "miss"
+    if findings is None:
+        findings = analyze_paths(paths, root, timings,
+                                 suppressed_out=suppressed)
+        if not args.no_cache:
+            result_cache.store(cache_path, version, keys,
+                               findings, suppressed)
     elapsed = time.perf_counter() - t0
 
     baseline_path = args.baseline or _DEFAULT_BASELINE
@@ -149,8 +221,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f"seaweedlint: {_summarize(findings)}; "
               f"{len(gating)} new at gate severity "
               f"'{args.gate}'")
+    if args.families and fmt == "human":
+        for line in _family_table(findings, new, suppressed):
+            print(line)
     if args.stats:
         _print_stats(timings, elapsed)
+        print(f"  cache: {cache_state} ({cache_hits} file(s) "
+              f"unchanged, {cache_misses} changed/new/removed)")
     if over_budget:
         print(f"seaweedlint: runtime budget exceeded: {elapsed:.1f}s "
               f"> {args.budget_seconds:.1f}s", file=sys.stderr)

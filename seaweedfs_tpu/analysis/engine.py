@@ -19,6 +19,7 @@ from .local_rules import check_local
 from .lockgraph import Project, analyze_locks
 from .model import ModuleInfo, collect_module
 from .net_rules import check_net
+from .race_rules import check_races
 
 #: Generated / vendored files the rules should not police.
 _EXCLUDE_PARTS = {"__pycache__"}
@@ -57,10 +58,15 @@ def module_name_for(path: Path, root: Path) -> str:
 
 def analyze_sources(sources: dict[str, str],
                     module_names: dict[str, str] | None = None,
-                    timings: dict[str, float] | None = None
+                    timings: dict[str, float] | None = None,
+                    suppressed_out: list[Finding] | None = None
                     ) -> list[Finding]:
     """Analyze {repo-relative path: source text}. The unit the tests
-    drive: no filesystem involved."""
+    drive: no filesystem involved.
+
+    ``suppressed_out``, when given, receives the findings an inline
+    pragma silenced (the lint_gate summary table counts them).
+    """
     t = timings if timings is not None else {}
 
     def timed(label, fn):
@@ -100,12 +106,17 @@ def analyze_sources(sources: dict[str, str],
     findings.extend(timed("SW5xx buffer", lambda: check_buffers(fp)))
     findings.extend(timed("SW6xx net", lambda: check_net(fp, sources)))
     findings.extend(timed("SW7xx jax", lambda: check_jax(modules)))
+    findings.extend(timed("SW8xx races", lambda: check_races(fp)))
 
     def finish():
-        kept = [
-            f for f in findings
-            if not is_suppressed(f, sources,
-                                 tuple(f.extra.get("anchors", ())))]
+        kept = []
+        for f in findings:
+            if is_suppressed(f, sources,
+                             tuple(f.extra.get("anchors", ()))):
+                if suppressed_out is not None:
+                    suppressed_out.append(f)
+            else:
+                kept.append(f)
         fingerprint_findings(kept, sources)
         kept.sort(key=Finding.sort_key)
         return kept
@@ -114,7 +125,8 @@ def analyze_sources(sources: dict[str, str],
 
 
 def analyze_paths(paths: list[str], root: Path,
-                  timings: dict[str, float] | None = None
+                  timings: dict[str, float] | None = None,
+                  suppressed_out: list[Finding] | None = None
                   ) -> list[Finding]:
     files = discover_files(paths, root)
     sources: dict[str, str] = {}
@@ -127,7 +139,8 @@ def analyze_paths(paths: list[str], root: Path,
         sources[rel] = f.read_text(encoding="utf-8",
                                    errors="replace")
         names[rel] = module_name_for(f, root)
-    return analyze_sources(sources, names, timings)
+    return analyze_sources(sources, names, timings,
+                           suppressed_out=suppressed_out)
 
 
 def parse_ok(source: str) -> bool:

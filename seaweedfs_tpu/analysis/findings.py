@@ -62,18 +62,83 @@ def fingerprint_findings(findings: list[Finding],
 
 _SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
 
+#: Per-rule SARIF metadata: short name, default severity, help text.
+#: Rules not listed fall back to severity-derived metadata; the SW8xx
+#: family is enumerated so editors render actionable guidance
+#: (docs/static_analysis.md holds the full catalog).
+RULE_META: dict[str, dict] = {
+    "SW801": {
+        "name": "UnlockedSharedAttributeWrite",
+        "severity": "error",
+        "help": (
+            "Attribute is written from two or more thread roles with "
+            "an empty guaranteed-lockset intersection: no single lock "
+            "consistently protects it. Guard every write with one "
+            "lock, confine the attribute to a single thread, or "
+            "pragma a deliberate single-writer/atomic-rebind design "
+            "with a justification."),
+    },
+    "SW802": {
+        "name": "CompoundUpdateOutsideLock",
+        "severity": "warning",
+        "help": (
+            "Read-modify-write (`x += 1`) or check-then-set on a "
+            "shared attribute outside any lock: two threads can "
+            "interleave between the read and the write and lose an "
+            "update. Take the guarding lock around the whole "
+            "compound step."),
+    },
+    "SW803": {
+        "name": "UnguardedSharedCollectionMutation",
+        "severity": "warning",
+        "help": (
+            "A dict/list/set reachable from multiple thread roles is "
+            "mutated without a lock. Single CPython ops are "
+            "GIL-atomic, but iteration, multi-step updates, and "
+            "free-threaded builds are not — guard the collection or "
+            "document the single-writer protocol."),
+    },
+    "SW804": {
+        "name": "PublishBeforeInit",
+        "severity": "error",
+        "help": (
+            "`self` escapes to another thread (Thread(target=...), "
+            "registry, callback) before __init__ finishes assigning "
+            "attributes; the spawned thread can observe a half-built "
+            "object. Finish construction, then publish."),
+    },
+}
+
 
 def to_sarif(findings: list[Finding], tool_version: str = "2") -> dict:
     """SARIF 2.1.0 document for CI/editor consumption
-    (``seaweedlint --format=sarif``)."""
+    (``seaweedlint --format=sarif``). Rules with :data:`RULE_META`
+    entries (the SW8xx race family) are emitted even when they have
+    no findings in this run, so consumers always see their help text
+    and default severity."""
     rules: dict[str, dict] = {}
+
+    def rule_obj(rule: str, severity: str) -> dict:
+        meta = RULE_META.get(rule)
+        if meta is None:
+            return {"id": rule,
+                    "defaultConfiguration": {
+                        "level": _SARIF_LEVELS.get(severity, "note")}}
+        return {
+            "id": rule,
+            "name": meta["name"],
+            "shortDescription": {"text": meta["name"]},
+            "help": {"text": meta["help"]},
+            "helpUri": "docs/static_analysis.md",
+            "defaultConfiguration": {
+                "level": _SARIF_LEVELS.get(meta["severity"], "note")},
+        }
+
+    for rule in RULE_META:
+        rules[rule] = rule_obj(rule, RULE_META[rule]["severity"])
     results = []
     for f in findings:
-        rules.setdefault(f.rule, {
-            "id": f.rule,
-            "defaultConfiguration": {
-                "level": _SARIF_LEVELS.get(f.severity, "note")},
-        })
+        rules.setdefault(f.rule, rule_obj(f.rule, f.severity))
         results.append({
             "ruleId": f.rule,
             "level": _SARIF_LEVELS.get(f.severity, "note"),

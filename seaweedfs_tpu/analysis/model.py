@@ -231,40 +231,9 @@ class ModuleCollector(ast.NodeVisitor):
     # ---- lock references / acquisition ----
 
     def _lock_ref(self, expr: ast.expr) -> Optional[str]:
-        """Resolve a with-context expression to a lock id, or None."""
-        if isinstance(expr, ast.Attribute) and \
-                isinstance(expr.value, ast.Name):
-            base, attr = expr.value.id, expr.attr
-            if base == "self" and self._class is not None:
-                d = self._class.lock_defs.get(attr)
-                if d is not None:
-                    return d.alias_of or d.lock_id
-                if looks_locky(attr):
-                    return f"{self.mi.name}.{self._class.name}.{attr}"
-                return None
-            mod = self.mi.imports.get(base)
-            if mod and looks_locky(attr):
-                return f"{mod}.{attr}"
-            if looks_locky(attr):  # other_obj._lock — name-scoped
-                return f"{self.mi.name}.<{base}>.{attr}"
-            return None
-        if isinstance(expr, ast.Name):
-            d = self.mi.module_locks.get(expr.id)
-            if d is not None:
-                return d.alias_of or d.lock_id
-            tgt = self.mi.from_imports.get(expr.id)
-            if tgt and looks_locky(expr.id):
-                return f"{tgt[0]}.{tgt[1]}"
-            if looks_locky(expr.id):
-                scope = self._func.key if self._func else self.mi.name
-                return f"{scope}.{expr.id}"
-            return None
-        if isinstance(expr, ast.Subscript):
-            text = ast.unparse(expr.value)
-            if looks_locky(text):
-                scope = self._func.key if self._func else self.mi.name
-                return f"{scope}.{text}[]"
-        return None
+        return resolve_lock_ref(
+            expr, self.mi, self._class,
+            self._func.key if self._func else None)
 
     def lock_kind(self, lock_id: str) -> str:
         for defs in (self.mi.module_locks,
@@ -352,6 +321,51 @@ class ModuleCollector(ast.NodeVisitor):
             if ref is not None:
                 f.calls.append((ref, node.lineno, held, wlines))
         self.generic_visit(node)
+
+
+def resolve_lock_ref(expr: ast.expr, mi: ModuleInfo,
+                     cls: Optional[ClassInfo],
+                     func_key: Optional[str]) -> Optional[str]:
+    """Resolve a with-context expression to a lock id, or None.
+
+    Shared by the collector above and the thread-role model
+    (threads.py): both layers must agree on what lock a ``with``
+    statement holds so the static SW8xx locksets line up with the
+    SW1xx lock graph.
+    """
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name):
+        base, attr = expr.value.id, expr.attr
+        if base == "self" and cls is not None:
+            d = cls.lock_defs.get(attr)
+            if d is not None:
+                return d.alias_of or d.lock_id
+            if looks_locky(attr):
+                return f"{mi.name}.{cls.name}.{attr}"
+            return None
+        mod = mi.imports.get(base)
+        if mod and looks_locky(attr):
+            return f"{mod}.{attr}"
+        if looks_locky(attr):  # other_obj._lock — name-scoped
+            return f"{mi.name}.<{base}>.{attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        d = mi.module_locks.get(expr.id)
+        if d is not None:
+            return d.alias_of or d.lock_id
+        tgt = mi.from_imports.get(expr.id)
+        if tgt and looks_locky(expr.id):
+            return f"{tgt[0]}.{tgt[1]}"
+        if looks_locky(expr.id):
+            scope = func_key or mi.name
+            return f"{scope}.{expr.id}"
+        return None
+    if isinstance(expr, ast.Subscript):
+        text = ast.unparse(expr.value)
+        if looks_locky(text):
+            scope = func_key or mi.name
+            return f"{scope}.{text}[]"
+    return None
 
 
 def call_ref(fn: ast.expr, mi: ModuleInfo) -> Optional[tuple]:

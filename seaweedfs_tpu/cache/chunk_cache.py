@@ -217,6 +217,8 @@ class ChunkCache:
                 if vol:
                     self._volumes.setdefault(vol, set()).add(key)
         invalidation.register_cache(self)
+        from ..util import racecheck
+        racecheck.register(self, "cache.ChunkCache")
 
     # ------------- internal -------------
 

@@ -141,7 +141,11 @@ class ClusterInvalidationHub:
             self._queue(url).send(event)
             n += 1
         if n:
-            self.published += 1
+            # publish() is called from every mutating request thread;
+            # the counter increment needs the same lock the subscriber
+            # map uses or concurrent publishes lose ticks
+            with self._lock:
+                self.published += 1
             glog.v(1, "cache: invalidation of volume %d (%s) fanned "
                    "out to %d host(s)", volume_id, reason, n)
         return n

@@ -81,6 +81,9 @@ class FilerServer:
             raw = self.filer.read_file(
                 path_conf_mod.FILER_CONF_PATH, self.master)
         except FilerError:
+            # whole-object rebind of an immutable PathConf: readers
+            # see the old or the new set, never a mix
+            # seaweedlint: disable=SW801 — atomic reference swap
             self.path_conf = path_conf_mod.PathConf()  # confirmed gone
             return
         except Exception as e:  # noqa: BLE001 — keep previous rules

@@ -771,6 +771,8 @@ class PolicyEngine:
                          type(e).__name__, e)
 
     def tick(self, now: Optional[float] = None) -> list[dict]:
+        # only the master's single reap loop calls tick()
+        # seaweedlint: disable=SW802 — single reap-loop caller
         self.ticks += 1
         acts = self.evaluate(self.cluster_rows(), now)
         for a in acts:

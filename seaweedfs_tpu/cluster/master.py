@@ -151,6 +151,10 @@ class MasterServer:
         self.cache_hub = invalidation_mod.ClusterInvalidationHub()
         self._pusher = None
         self._channels: dict[str, object] = {}
+        # dial cache is hit from the reap/vacuum/ttl loops, job
+        # workers AND ingress handlers; unlocked check-then-set would
+        # leak a duplicate (never-closed) channel per lost race
+        self._chan_lock = threading.Lock()
         self._grpc_server = None
         self._http_server: Optional[httpserver.IngressHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
@@ -276,6 +280,8 @@ class MasterServer:
                 # Off the reap thread: a hung VolumeDelete must not
                 # stall dead-node detection (same rationale as the
                 # vacuum scan below).
+                # check-then-spawn runs only on the single reap loop
+                # seaweedlint: disable=SW802 — single reap-loop caller
                 self._ttl_thread = threading.Thread(
                     target=self._reap_ttl_safe, daemon=True,
                     name="master-ttl-reap")
@@ -287,6 +293,8 @@ class MasterServer:
                          or not self._vacuum_thread.is_alive()):
                 # Off the reap thread: a long compaction must not stall
                 # dead-node detection.
+                # check-then-spawn runs only on the single reap loop
+                # seaweedlint: disable=SW802 — single reap-loop caller
                 self._vacuum_thread = threading.Thread(
                     target=self._scan_and_vacuum_safe, daemon=True,
                     name="master-vacuum-scan")
@@ -433,13 +441,14 @@ class MasterServer:
     def _volume_stub(self, node_url: str) -> pb.Stub:
         import grpc
 
-        ch = self._channels.get(node_url)
-        if ch is None:
-            ip, http_port = node_url.rsplit(":", 1)
-            ch = security.grpc_auth_channel(
-                tls_mod.dial(
-                    f"{ip}:{_grpc_port(int(http_port))}"), self.guard)
-            self._channels[node_url] = ch
+        with self._chan_lock:
+            ch = self._channels.get(node_url)
+            if ch is None:
+                ip, http_port = node_url.rsplit(":", 1)
+                ch = security.grpc_auth_channel(
+                    tls_mod.dial(
+                        f"{ip}:{_grpc_port(int(http_port))}"), self.guard)
+                self._channels[node_url] = ch
         return pb.volume_stub(ch)
 
     # ------------- core ops -------------

@@ -150,6 +150,10 @@ class SpaceSaving:
             merged[key] = [count, error, meta[2], meta[3]]
         keep = sorted(merged, key=lambda k: (-merged[k][0], k))
         self._entries = {k: merged[k] for k in keep[:self.capacity]}
+        # per the class docstring the sketch is lock-free by design:
+        # every caller (collector, master aggregation) serializes
+        # merges under its own lock
+        # seaweedlint: disable=SW802 — callers hold their own lock
         self.total += other.total
 
     def entries(self) -> list[dict]:
@@ -361,6 +365,9 @@ class UsagePusher:
             data=json.dumps(body).encode(), method="POST",
             headers={"Content-Type": "application/json"},
             point="usage.push", timeout=5.0, use_breaker=False)
+        # incremented only on the single pusher thread; stop() joins
+        # without a final flush
+        # seaweedlint: disable=SW802 — single pusher thread
         self.pushed += 1
 
     def _loop(self) -> None:
@@ -370,6 +377,7 @@ class UsagePusher:
             try:
                 self.push_once()
             except Exception as e:
+                # seaweedlint: disable=SW802 — single pusher thread
                 self.errors += 1
                 glog.v(1, "usage push to %s failed: %s",
                        self.master_url, e)
@@ -538,11 +546,15 @@ class ClusterUsage:
     def _tenant_label(self, tenant: str) -> str:
         """First TENANT_GAUGE_CAP distinct tenants keep their name;
         later ones share "other" so the series set stays bounded."""
-        if tenant in self._tenant_labels:
-            return tenant
-        if len(self._tenant_labels) < TENANT_GAUGE_CAP:
-            self._tenant_labels.add(tenant)
-            return tenant
+        # under the lock: gauge updates run on ingest (rpc) threads
+        # AND the reap loop, and an unlocked check-then-add lets the
+        # label set blow past the cap
+        with self._lock:
+            if tenant in self._tenant_labels:
+                return tenant
+            if len(self._tenant_labels) < TENANT_GAUGE_CAP:
+                self._tenant_labels.add(tenant)
+                return tenant
         return "other"
 
     def _update_gauges(self) -> None:

@@ -236,6 +236,10 @@ class VolumeServer:
         if len(self.master_urls) > 1:
             i = self.master_urls.index(self.master_url) \
                 if self.master_url in self.master_urls else 0
+            # failover re-point: a str rebind is atomic; a racing
+            # reader uses either the dying master (and fails over
+            # itself) or the new one
+            # seaweedlint: disable=SW801 — atomic failover re-point
             self.master_url = self.master_urls[
                 (i + 1) % len(self.master_urls)]
 
@@ -345,6 +349,9 @@ class VolumeServer:
                 glog.v(1, "volume %s: following leader %s", self.url,
                        resp.leader)
                 if resp.leader not in self.master_urls:
+                    # worst case under a race is a duplicate rotation
+                    # entry, which only repeats a failover hop
+                    # seaweedlint: disable=SW803 — benign duplicate
                     self.master_urls.append(resp.leader)
                 self.master_url = resp.leader
                 return
@@ -536,6 +543,11 @@ class _VolumeServicer:
         vol = store.get_volume(request.volume_id, request.collection)
         from ..storage import vacuum as vacuum_mod
 
+        # keyed per volume, and the vacuum_in_progress claim (taken
+        # under vol._lock inside compact) already excludes concurrent
+        # compacts of the SAME volume; distinct-key dict ops are
+        # GIL-atomic
+        # seaweedlint: disable=SW803 — per-volume claim excludes races
         self._compact_states[(request.collection, request.volume_id)] = \
             vacuum_mod.compact(vol)
         return volume_server_pb2.VacuumVolumeCompactResponse()

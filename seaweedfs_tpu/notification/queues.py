@@ -146,6 +146,9 @@ class FilerNotifier:
                         continue
                     try:
                         self.queue.send(event_to_dict(ev))
+                        # all three counters mutate only on the
+                        # single filer-notifier thread
+                        # seaweedlint: disable=SW802 — single thread
                         self.published += 1
                     except Exception as e:  # noqa: BLE001 — keep going
                         glog.warning("notification publish failed: %s",
@@ -155,11 +158,13 @@ class FilerNotifier:
                 from ..filer.filer import FilerResyncRequired
 
                 registered = None
+                # seaweedlint: disable=SW802 — single notifier thread
                 self.resubscribed += 1
                 window_gone = (isinstance(e, FilerResyncRequired)
                                and "window expired" in str(e))
                 if window_gone or not last_ts:
                     # beyond the replay window: genuinely lost ground
+                    # seaweedlint: disable=SW802 — single thread
                     self.lost += 1
                     since = 0
                     glog.warning("notification stream lost events "

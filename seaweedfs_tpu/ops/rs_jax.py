@@ -127,6 +127,9 @@ def _donate() -> bool:
             import warnings
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
+            # idempotent one-way latch: racing writers both set True;
+            # double-filtering a warning is harmless
+            # seaweedlint: disable=SW801 — idempotent latch
             _donation_warning_squelched = True
     return on
 

@@ -483,6 +483,10 @@ def _auto_mesh_for(b: int) -> Mesh:
     if _auto_n_devices != n_dev:
         _auto_meshes.clear()
         _auto_steps.clear()  # steps bake their mesh into shard_map
+        # device-count memo: jax.devices() is stable per process, so
+        # every writer stores the same value and a racing re-clear
+        # only costs a mesh rebuild
+        # seaweedlint: disable=SW801 — idempotent memo
         _auto_n_devices = n_dev
     dp_auto, _ = _auto_factor(n_dev)
     dp = dp_auto if b >= dp_auto else 1

@@ -46,7 +46,7 @@ from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
-from ..util import bufcheck
+from ..util import bufcheck, racecheck
 from . import flight
 
 # Arm the runtime pooled-buffer checker straight from the environment
@@ -60,6 +60,13 @@ bufcheck.install_from_env()
 # lifecycle recording (scripts/flight_smoke.sh); unset means every
 # flight.record() below is one attribute load + None test.
 flight.install_from_env()
+
+# And for the Eraser lockset race checker: SEAWEED_RACECHECK=raise
+# arms the race-armed pipeline_smoke leg of lint_gate so an
+# unsynchronized write to a registered shared object (pools, stats,
+# controllers) faults the smoke instead of passing silently. Unset
+# means every racecheck.register() below is one flag test.
+racecheck.install_from_env()
 
 #: Stage-queue depth: 2 = classic double buffering (config default).
 DEPTH = 2
@@ -186,6 +193,7 @@ class HostBufferPool:
             buf = np.frombuffer(m, dtype=np.uint8)
             bufcheck.register(buf, m)
             self._free.put(buf)
+        racecheck.register(self, "pipeline.HostBufferPool")
 
     def acquire(self, timeout: Optional[float] = None) -> np.ndarray:
         """A free (nbytes,) uint8 buffer; blocks until one is
@@ -363,6 +371,7 @@ class GroupController:
         self._per_batch: dict[int, float] = {}
         self._ewma_read = 0.0
         self._starve = 0.0
+        racecheck.register(self, "pipeline.GroupController")
 
     def note_read(self, seconds: float) -> None:
         self._ewma_read = seconds if not self._ewma_read else \
@@ -475,6 +484,7 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
     if overlapped is None:
         overlapped = cfg.overlapped
     st = stats if stats is not None else PipeStats()
+    racecheck.register(st, "pipeline.PipeStats")
     grouping = encode_multi_fn is not None and group > 1
     if grouping and prepare_fn is not None:
         raise ValueError(
@@ -509,6 +519,9 @@ def run_pipeline(batches: Iterable[tuple[Any, np.ndarray]],
                 flight.publish_run_gauges()
             except Exception:  # seaweedlint: disable=SW301 — observability must not fail the observed run
                 pass
+        # stage threads are joined: a later run may legitimately
+        # drive the same stats object from a different thread
+        racecheck.quiesce(st)
     return n
 
 
@@ -555,10 +568,19 @@ def _run_sync(batches, encode_fn, write_fn, recycle_fn,
             recycle_fn(meta, batch)
         st.write_seconds += time.perf_counter() - t3
         flight.record(flight.EV_WRITE_END, batch=seq)
+        # PipeStats fields have exactly one writer per run (the
+        # driving thread of THIS encode); the roles the analyzer
+        # unions are alternative drivers, never concurrent on one
+        # stats object, and readers wait for join
+        # seaweedlint: disable=SW801 — single driver per stats object
         st.batches += 1
+        # seaweedlint: disable=SW801 — same single-driver contract
         st.groups += 1
+        # seaweedlint: disable=SW801 — same single-driver contract
         st.max_group = max(st.max_group, 1)
+        # seaweedlint: disable=SW801 — same single-driver contract
         st.bytes_in += _batch_nbytes(batch)
+        # seaweedlint: disable=SW801 — same single-driver contract
         st.bytes_out += result_np.nbytes
     return n or st.batches
 

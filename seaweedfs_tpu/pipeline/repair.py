@@ -121,11 +121,14 @@ class IntervalRepairAggregator:
             if item is None:
                 return
             batch = self._drain(item)
+            # counters mutate only on the single ec-repair-agg thread
+            # seaweedlint: disable=SW802 — single agg thread
             self.requests += len(batch)
             groups: dict[tuple, list[_Request]] = {}
             for r in batch:
                 groups.setdefault((r.present, r.wanted), []).append(r)
             for (present, wanted), reqs in groups.items():
+                # seaweedlint: disable=SW802 — single agg thread
                 self.batches += 1
                 try:
                     smax = max(r.size for r in reqs)

@@ -28,7 +28,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from ..util import bufcheck
+from ..util import bufcheck, racecheck
 from . import flight
 
 #: Linux UIO_MAXIOV; one pwritev can scatter at most this many
@@ -133,6 +133,9 @@ class WriterPool:
             threading.Thread(target=self._worker, args=(q,),
                              name=f"ec-writeback-{i}", daemon=True)
             for i, q in enumerate(self._queues)]
+        # fully built; register BEFORE the workers START so every
+        # cross-thread write is seen by the lockset checker
+        racecheck.register(self, "pipeline.WriterPool")
         for t in self._workers:
             t.start()
 
@@ -150,6 +153,9 @@ class WriterPool:
         fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
         if preallocate_file and size > 0:
             preallocate(fd, size)
+        # open_file is a setup call: the fd is registered before any
+        # write for it is submitted, so workers only READ the entry
+        # seaweedlint: disable=SW803 — registered before use
         self._fds[path] = fd
 
     # -- job submission --------------------------------------------------
@@ -218,33 +224,47 @@ class WriterPool:
 
     def _worker(self, q: queue.Queue) -> None:
         import time
-        while True:
-            item = q.get()
-            if item is _END:
-                return
-            fd, offset, rows, token = item[:4]
-            tags = item[4] if len(item) > 4 else None
-            if self._errors:
-                # fail fast but keep draining (and keep firing tokens
-                # so pooled buffers are not leaked on the error path)
-                if token is not None:
-                    token.done_one()
-                continue
-            t0 = time.perf_counter()
-            try:
-                bufcheck.verify_rows(tags, where="before pwritev")
-                wrote = pwrite_rows(fd, offset, rows)
-                # re-check AFTER the write: a recycle that raced the
-                # pwritev corrupted the bytes already on disk
-                bufcheck.verify_rows(tags, where="after pwritev")
-                dt = time.perf_counter() - t0
-                flight.record(flight.EV_PWRITEV_RETIRE, value=dt,
-                              arg=wrote)
-                with self._busy_lock:
-                    self.bytes_written += wrote
-                    self.busy_seconds += dt
-            except BaseException as e:  # noqa: BLE001 — re-raised at submit/close
-                self._errors.append(e)
-            finally:
-                if token is not None:
-                    token.done_one()
+        bytes_acc, busy_acc = 0, 0.0
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                fd, offset, rows, token = item[:4]
+                tags = item[4] if len(item) > 4 else None
+                if self._errors:
+                    # fail fast but keep draining (and keep firing
+                    # tokens so pooled buffers are not leaked on the
+                    # error path)
+                    if token is not None:
+                        token.done_one()
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    bufcheck.verify_rows(tags, where="before pwritev")
+                    wrote = pwrite_rows(fd, offset, rows)
+                    # re-check AFTER the write: a recycle that raced
+                    # the pwritev corrupted the bytes already on disk
+                    bufcheck.verify_rows(tags, where="after pwritev")
+                    dt = time.perf_counter() - t0
+                    flight.record(flight.EV_PWRITEV_RETIRE, value=dt,
+                                  arg=wrote)
+                    bytes_acc += wrote
+                    busy_acc += dt
+                except BaseException as e:  # noqa: BLE001 — re-raised at submit/close
+                    # list.append is GIL-atomic and the list is only
+                    # drained after the workers join
+                    # seaweedlint: disable=SW803 — drained after join
+                    self._errors.append(e)
+                finally:
+                    if token is not None:
+                        token.done_one()
+        finally:
+            # one flush per worker lifetime: the pool counters are
+            # only read after close() joins the workers, so per-job
+            # locked updates buy nothing and cost a cross-thread
+            # synchronized write per pwritev (which the armed lockset
+            # race checker would also have to track, job by job)
+            with self._busy_lock:
+                self.bytes_written += bytes_acc
+                self.busy_seconds += busy_acc

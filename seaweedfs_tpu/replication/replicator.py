@@ -68,6 +68,10 @@ class _WalkHold:
                 if err is None:
                     for path, new, old, ts, sigs in self._buffer:
                         rep._apply(path, new, old, sigs)
+                        # the watermark advances only on the single
+                        # filer-replicator thread; bootstrap hands
+                        # off before the live stream starts consuming
+                        # seaweedlint: disable=SW801 — single thread
                         rep.last_ts_ns = max(rep.last_ts_ns, ts)
                 self._buffer.clear()
             if err is not None:
@@ -185,6 +189,9 @@ class Replicator:
 
         if self._channel is None:
             ip, http_port = self.source_url.rsplit(":", 1)
+            # dialed and torn down only on the single filer-replicator
+            # thread
+            # seaweedlint: disable=SW802 — single replicator thread
             self._channel = tls_mod.dial(
                 f"{ip}:{_grpc_port(int(http_port))}")
         return pb.filer_stub(self._channel)
@@ -233,6 +240,7 @@ class Replicator:
                         self._channel.close()
                     except Exception as ce:  # noqa: BLE001
                         glog.v(2, "stale channel close failed: %s", ce)
+                    # seaweedlint: disable=SW802 — single thread
                     self._channel = None
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 5.0)

@@ -14,6 +14,7 @@ Volume base naming follows the reference: ``<vid>`` or
 from __future__ import annotations
 
 import re
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterator, Optional
@@ -122,6 +123,12 @@ class Store:
         self.volumes: dict[tuple[str, int], Volume] = {}
         self.ec_mounts: dict[tuple[str, int], EcVolumeMount] = {}
         self.readonly: set[tuple[str, int]] = set()
+        # Guards the three registry maps above — and ONLY them. Admin
+        # gRPC threads mount/unmount/delete while the heartbeat thread
+        # snapshots status() and job workers flip readonly marks; all
+        # volume I/O (load/create/close/stat) stays OUTSIDE the lock
+        # so a slow disk can never stall the heartbeat.
+        self._lock = threading.RLock()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -134,22 +141,26 @@ class Store:
                 if (col, vid) not in self.volumes:
                     vol = Volume(base, vid, backend=self.backend,
                                  needle_map=self.needle_map).load()
-                    self.volumes[(col, vid)] = vol
-                    if vol.readonly:
-                        # tiered (.tier sidecar): the durable read-only
-                        # marker must survive restarts so heartbeats
-                        # never advertise the volume writable
-                        self.readonly.add((col, vid))
+                    with self._lock:
+                        self.volumes[(col, vid)] = vol
+                        if vol.readonly:
+                            # tiered (.tier sidecar): the durable
+                            # read-only marker must survive restarts so
+                            # heartbeats never advertise the volume
+                            # writable
+                            self.readonly.add((col, vid))
             for col, vid, base, ids in loc.scan_ec_shards():
-                m = self.ec_mounts.setdefault(
-                    (col, vid), EcVolumeMount(base, col, vid))
-                m.shard_ids.update(ids)
+                with self._lock:
+                    m = self.ec_mounts.setdefault(
+                        (col, vid), EcVolumeMount(base, col, vid))
+                    m.shard_ids.update(ids)
 
     def close(self) -> None:
-        for v in self.volumes.values():
+        for v in list(self.volumes.values()):
             v.close()
-        self.volumes.clear()
-        self.ec_mounts.clear()
+        with self._lock:
+            self.volumes.clear()
+            self.ec_mounts.clear()
 
     def _pick_location(self) -> DiskLocation:
         """Least-loaded location with free volume slots."""
@@ -178,7 +189,8 @@ class Store:
         vol = Volume(loc.base_for(volume_id, collection), volume_id,
                      sb, backend=self.backend,
                      needle_map=self.needle_map).create()
-        self.volumes[key] = vol
+        with self._lock:
+            self.volumes[key] = vol
         return vol
 
     def get_volume(self, volume_id: int, collection: str = "") -> Volume:
@@ -194,12 +206,14 @@ class Store:
         """VolumeMarkReadonly: freeze writes ahead of ec.encode
         (volume server admin gRPC; SURVEY.md §3.1)."""
         self.get_volume(volume_id, collection)  # must exist
-        self.readonly.add((collection, volume_id))
+        with self._lock:
+            self.readonly.add((collection, volume_id))
 
     def mark_writable(self, volume_id: int, collection: str = "") -> None:
         """VolumeMarkWritable: undo a freeze (balance rollback path)."""
         self.get_volume(volume_id, collection)  # must exist
-        self.readonly.discard((collection, volume_id))
+        with self._lock:
+            self.readonly.discard((collection, volume_id))
 
     def is_readonly(self, volume_id: int, collection: str = "") -> bool:
         return (collection, volume_id) in self.readonly
@@ -229,7 +243,8 @@ class Store:
         # check — none can append between the sync and the upload.
         with vol._lock:
             vol.readonly = True
-        self.readonly.add(key)
+        with self._lock:
+            self.readonly.add(key)
         if on_sealed is not None:
             on_sealed()
         try:
@@ -240,7 +255,8 @@ class Store:
                 remove_local=not keep_local)
         except BaseException:
             if not was_readonly:
-                self.readonly.discard(key)
+                with self._lock:
+                    self.readonly.discard(key)
             if not was_vol_readonly:
                 with vol._lock:
                     vol.readonly = False
@@ -259,7 +275,8 @@ class Store:
             raise StoreError(f"volume {volume_id} is not tiered")
         tier_mod.download_volume_dat(vol.base)
         vol.retier()
-        self.readonly.discard((collection, volume_id))
+        with self._lock:
+            self.readonly.discard((collection, volume_id))
         return vol.dat_size
 
     def unmount_volume(self, volume_id: int,
@@ -269,7 +286,8 @@ class Store:
         directory by hand or freezing it for external tooling."""
         vol = self.get_volume(volume_id, collection)
         vol.close()
-        del self.volumes[(collection, volume_id)]
+        with self._lock:
+            self.volumes.pop((collection, volume_id), None)
         # the readonly mark is deliberately KEPT: an operator (or the
         # ec.encode/move choreography) that froze the volume must not
         # find it silently writable again after an unmount/mount cycle
@@ -288,9 +306,10 @@ class Store:
                     tier_mod.TierInfo.path_for(base).exists():
                 vol = Volume(base, volume_id, backend=self.backend,
                              needle_map=self.needle_map).load()
-                self.volumes[(collection, volume_id)] = vol
-                if vol.readonly:
-                    self.readonly.add((collection, volume_id))
+                with self._lock:
+                    self.volumes[(collection, volume_id)] = vol
+                    if vol.readonly:
+                        self.readonly.add((collection, volume_id))
                 return
         raise StoreError(
             f"no files for volume {volume_id} "
@@ -301,8 +320,9 @@ class Store:
         volume this way)."""
         vol = self.get_volume(volume_id, collection)
         vol.close()
-        del self.volumes[(collection, volume_id)]
-        self.readonly.discard((collection, volume_id))
+        with self._lock:
+            self.volumes.pop((collection, volume_id), None)
+            self.readonly.discard((collection, volume_id))
         # .sdx goes too: a leftover sqlite map would resurrect phantom
         # index entries if the volume id is ever re-allocated.
         for p in (dat_path(vol.base), idx_path(vol.base),
@@ -436,20 +456,22 @@ class Store:
         if missing:
             raise StoreError(
                 f"shard files missing for volume {volume_id}: {missing}")
-        m = self.ec_mounts.setdefault(
-            (collection, volume_id),
-            EcVolumeMount(base, collection, volume_id))
-        m.shard_ids.update(shard_ids)
+        with self._lock:
+            m = self.ec_mounts.setdefault(
+                (collection, volume_id),
+                EcVolumeMount(base, collection, volume_id))
+            m.shard_ids.update(shard_ids)
         return m
 
     def unmount_ec_shards(self, volume_id: int, shard_ids: list[int],
                           collection: str = "") -> None:
-        m = self.ec_mounts.get((collection, volume_id))
-        if m is None:
-            return
-        m.shard_ids.difference_update(shard_ids)
-        if not m.shard_ids:
-            del self.ec_mounts[(collection, volume_id)]
+        with self._lock:
+            m = self.ec_mounts.get((collection, volume_id))
+            if m is None:
+                return
+            m.shard_ids.difference_update(shard_ids)
+            if not m.shard_ids:
+                del self.ec_mounts[(collection, volume_id)]
 
     # -- status / heartbeat ----------------------------------------------
 
@@ -483,15 +505,24 @@ class Store:
             glog.warning(
                 "volume %d: ec shard file(s) %s vanished from disk; "
                 "unmounting them", key[1], gone)
-            m.shard_ids.intersection_update(present)
-            if not m.shard_ids:
-                self.ec_mounts.pop(key, None)
+            with self._lock:
+                m.shard_ids.intersection_update(present)
+                if not m.shard_ids:
+                    self.ec_mounts.pop(key, None)
 
     def status(self) -> dict:
         """Snapshot for heartbeats (§3.4): normal volumes + EC shard bits,
         the payload SendHeartbeat streams to the master."""
+        # snapshot under the registry lock; the per-volume stat() I/O
+        # below runs on the copy so a slow disk can't block mounts
+        with self._lock:
+            vol_items = sorted(self.volumes.items())
+            readonly = set(self.readonly)
+            ec = [{"id": vid, "collection": col,
+                   "ec_index_bits": m.shard_bits.bits}
+                  for (col, vid), m in sorted(self.ec_mounts.items())]
         vols = []
-        for (col, vid), v in sorted(self.volumes.items()):
+        for (col, vid), v in vol_items:
             try:
                 modified = int(dat_path(v.base).stat().st_mtime)
             except OSError:
@@ -501,13 +532,10 @@ class Store:
                 "size": v.dat_size, "file_count": v.nm.file_count,
                 "deleted_count": v.nm.deleted_count,
                 "deleted_bytes": v.nm.deleted_bytes,
-                "read_only": (col, vid) in self.readonly,
+                "read_only": (col, vid) in readonly,
                 "replica_placement": str(v.super_block.replica_placement),
                 "version": v.super_block.version,
                 "ttl": str(v.super_block.ttl),
                 "modified_at_second": modified,
             })
-        ec = [{"id": vid, "collection": col,
-               "ec_index_bits": m.shard_bits.bits}
-              for (col, vid), m in sorted(self.ec_mounts.items())]
         return {"volumes": vols, "ec_shards": ec}

@@ -81,6 +81,10 @@ def compact(vol: Volume) -> CompactState:
         if getattr(vol, "vacuum_in_progress", False):
             raise VolumeError(
                 f"volume {vol.volume_id}: compaction already in progress")
+        # the claim is taken under vol._lock; every later clear runs
+        # on the thread that holds the claim, so there is never a
+        # concurrent writer
+        # seaweedlint: disable=SW801 — claim taken under vol._lock
         vol.vacuum_in_progress = True
     try:
         return _compact_locked(vol)
@@ -239,8 +243,13 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
     except OSError:
         # Nothing swapped yet: reopen the untouched live files so the
         # volume stays serviceable; abort_compact discards .cpd/.cpx.
+        # commit runs holding the vacuum_in_progress claim with
+        # readers drained (swap-drain protocol above): exactly one
+        # thread touches the handles
+        # seaweedlint: disable=SW801 — swap-drain protocol
         vol._dat = backend_mod.open_backend(vol.backend_kind,
                                             dat_path(vol.base))
+        # seaweedlint: disable=SW801 — same swap-drain protocol
         vol._idx = open(idx_path(vol.base), "a+b")
         raise
     try:
@@ -257,6 +266,7 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
     vol.super_block = state.new_super
     if hasattr(vol.nm, "close"):
         vol.nm.close()
+    # seaweedlint: disable=SW801 — same swap-drain protocol
     vol.nm = vol._load_needle_map()
     vol.vacuum_in_progress = False
     return vol._dat.size()

@@ -240,6 +240,10 @@ class _Parker(threading.Thread):
         self._stopped = False
 
     def park(self, conn: _Conn) -> None:
+        # a _Conn has exactly one owner at any moment (selector loop
+        # OR one worker), handed off through the parked queue; no two
+        # threads hold it at once
+        # seaweedlint: disable=SW801 — single-owner handoff
         conn.parked_at = time.monotonic()
         with self._lock:
             if self._stopped:
@@ -363,6 +367,8 @@ class IngressHTTPServer(HTTPServer):
             t.start()
         self._parker = _Parker(self)
         self._parker.start()
+        from . import racecheck
+        racecheck.register(self, f"httpserver.Ingress[{component}]")
         _SERVERS.add(self)
 
     # -- accept path (runs on the serve_forever thread) ------------------
@@ -418,6 +424,7 @@ class IngressHTTPServer(HTTPServer):
         cfg = self.config
         if conn.handler is None:
             try:
+                # seaweedlint: disable=SW801 — single-owner handoff
                 conn.handler = self._handler_cls(
                     conn.sock, conn.addr, self)
             except Exception:  # noqa: BLE001 — setup failed, drop it
@@ -436,6 +443,7 @@ class IngressHTTPServer(HTTPServer):
             if getattr(h, "_ingress_drop", False) or h.close_connection:
                 self._close(conn)
                 return
+            # seaweedlint: disable=SW801 — single-owner handoff
             conn.requests += 1
             if conn.requests >= cfg.keepalive_max_requests:
                 self._close(conn)

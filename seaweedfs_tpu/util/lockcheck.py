@@ -33,8 +33,8 @@ import traceback
 from dataclasses import dataclass, field
 
 __all__ = ["install_from_env", "install", "uninstall", "enabled",
-           "violations", "reset", "LockOrderViolation", "TrackedLock",
-           "TRACKER"]
+           "violations", "reset", "held_locks", "LockOrderViolation",
+           "TrackedLock", "TRACKER"]
 
 _ORIG_LOCK = threading.Lock
 _ORIG_RLOCK = threading.RLock
@@ -243,6 +243,15 @@ def install_from_env() -> bool:
     elif mode == "raise":
         install(raise_on_violation=True)
     return _installed
+
+
+def held_locks() -> tuple:
+    """TrackedLocks the CALLING thread currently holds, in acquisition
+    order (reentrant acquires appear once per level). The racecheck
+    lockset checker reads this to compute candidate locksets; empty
+    whenever lockcheck was never installed, since only locks created
+    under the patched factories are tracked."""
+    return tuple(TRACKER._held())
 
 
 def violations() -> list[Violation]:

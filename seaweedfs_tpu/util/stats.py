@@ -322,6 +322,8 @@ class Metrics:
         self.namespace = namespace
         self._lock = threading.Lock()
         self._metrics: dict[tuple[str, tuple, str], object] = {}
+        from . import racecheck
+        racecheck.register(self, "stats.Metrics")
 
     def _get(self, kind: str, name: str, labels: dict[str, str],
              factory):
@@ -407,6 +409,10 @@ class MetricsPusher:
         self.errors = 0
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
+        # stop()'s final flush runs on the caller's thread and the
+        # join above it has a timeout: a hung push means BOTH threads
+        # can be inside push_once at once, so the counters need a lock
+        self._count_lock = threading.Lock()
 
     def start(self) -> "MetricsPusher":
         import threading
@@ -437,10 +443,12 @@ class MetricsPusher:
         try:
             # seaweedlint: disable=SW601 — best-effort fire-and-forget push to an out-of-cluster pushgateway: a breaker/retry would add queueing where dropping a sample is the correct behavior; bounded by the 5s timeout
             with urllib.request.urlopen(req, timeout=5):
-                self.pushed += 1
+                with self._count_lock:
+                    self.pushed += 1
                 return True
         except Exception:  # noqa: BLE001 — gateway may be down
-            self.errors += 1
+            with self._count_lock:
+                self.errors += 1
             return False
 
     def _run(self) -> None:

@@ -67,6 +67,24 @@ from seaweedfs_tpu.util import bufcheck  # noqa: E402
 
 bufcheck.install_from_env()
 
+# ---------------------------------------------------------------------------
+# Eraser lockset race checking (the dynamic half of SW801).
+#
+# Armed for the whole tier-1 suite: registered shared objects
+# (pipeline pools, stage stats, metrics registries, cache tiers, the
+# ingress server) intercept attribute writes and track the candidate
+# lockset per (object, attribute); a write whose lockset intersection
+# goes empty across threads is a race report, and any report left at
+# session end fails the run. Opt out with SEAWEED_RACECHECK=0; use
+# =raise to fault at the offending write.
+# ---------------------------------------------------------------------------
+
+os.environ.setdefault("SEAWEED_RACECHECK", "1")
+
+from seaweedfs_tpu.util import racecheck  # noqa: E402
+
+racecheck.install_from_env()
+
 
 def pytest_configure(config):
     # Tier-1 runs with -m 'not slow'; the slow tier holds the
@@ -89,13 +107,22 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             "seaweed bufcheck: dangling pooled-buffer views")
         for v in bviols:
             terminalreporter.write_line(v)
+    rviols = racecheck.races()
+    if rviols:
+        terminalreporter.section(
+            "seaweed racecheck: unsynchronized shared-state writes")
+        for v in rviols:
+            terminalreporter.write_line(v.describe())
 
 
 def pytest_sessionfinish(session, exitstatus):
     # Tests that deliberately provoke inversions (tests/test_lockcheck.py)
-    # clean up after themselves via lockcheck.reset(); anything left here
-    # is a real ordering bug observed somewhere in the suite.
+    # or races (tests/test_racecheck.py) clean up after themselves via
+    # lockcheck.reset() / racecheck.reset(); anything left here is a
+    # real bug observed somewhere in the suite.
     if lockcheck.violations() and session.exitstatus == 0:
+        session.exitstatus = 1
+    if racecheck.races() and session.exitstatus == 0:
         session.exitstatus = 1
 
 # ---------------------------------------------------------------------------
