@@ -65,6 +65,7 @@ PROFILE_TIMEOUT = 300    # profiler-overhead stage (CPU mini cluster)
 USAGE_TIMEOUT = 300      # usage-accounting-overhead stage (CPU mini cluster)
 JOBS_TIMEOUT = 300       # maintenance-plane-overhead stage (CPU mini cluster)
 INGRESS_TIMEOUT = 300    # ingress-admission-overhead stage (CPU mini cluster)
+SCRUB_TIMEOUT = 300      # paced-scrub-overhead stage (CPU mini cluster)
 SIM_TIMEOUT = 300        # cluster-at-scale sim stage (in-process master)
 CKPT_TIMEOUT = 600       # checkpoint/dataloader stage (CPU mini cluster)
 MESH_TIMEOUT = 600       # sharded-mesh encode/rebuild stage (docs/mesh.md)
@@ -267,6 +268,13 @@ def parent() -> None:
     rc, out = _run(["--child-ingress-overhead"], _scrubbed_env(),
                    INGRESS_TIMEOUT)
     stage_platforms["ingress"] = \
+        "cpu" if rc == 0 and _parse_result(out) is not None else None
+
+    # Paced-scrub foreground tax on the same path (ISSUE 20's <5% bar)
+    # plus the raw unpaced verification bandwidth (scrub_gibps).
+    rc, out = _run(["--child-scrub-overhead"], _scrubbed_env(),
+                   SCRUB_TIMEOUT)
+    stage_platforms["scrub"] = \
         "cpu" if rc == 0 and _parse_result(out) is not None else None
 
     # Flight-recorder tax on the overlapped encode path (ISSUE 17's
@@ -1765,6 +1773,64 @@ elif sys.argv[2] == "ingress":
     # keep-alive core are structural and serve both modes identically,
     # so the diff is exactly the per-request admission tax.
     from seaweedfs_tpu.util import httpserver as plane
+elif sys.argv[2] == "scrub":
+    # on = a background scrub thread CRC-walking both the SAME volume
+    # the foreground reads are served from and a large synthetic one,
+    # under the production token-bucket pacer (8 MiB/s default) — the
+    # docs/robustness.md steady state while a pass is in flight. The
+    # big volume keeps the pass spanning whole measurement blocks, the
+    # way an hour-long production pass would (without it the tiny
+    # served volume re-scrubs ~8x/s and the per-PASS sidecar fsync
+    # becomes a per-125ms artifact no real deployment pays). The pacer
+    # sleeps outside the volume lock, so the diff is the paced
+    # read+CRC foreground tax; off = scrubber idle.
+    import threading
+    from seaweedfs_tpu.storage import scrubber as _scrubber
+    from seaweedfs_tpu.storage.volume import generate_synthetic_volume
+    class plane:
+        _stop = None
+        _thr = None
+        _extra = None
+        @staticmethod
+        def _loop(stop):
+            # interruptible pacing + per-needle abort so the off-
+            # toggle's join() never waits out a multi-second pass
+            class _Abort(Exception):
+                pass
+            def _prog(frac):
+                if stop.is_set():
+                    raise _Abort
+            pacer = _scrubber.RatePacer(sleep=lambda s: stop.wait(s))
+            while not stop.is_set():
+                for v in (list(vol.store.volumes.values())
+                          + [plane._extra]):
+                    if stop.is_set():
+                        break
+                    try:
+                        _scrubber.scrub_volume(v, pacer, progress=_prog)
+                    except _Abort:
+                        break
+                    except Exception:
+                        pass
+        @staticmethod
+        def configure(enabled):
+            if enabled and plane._thr is None:
+                if plane._extra is None:
+                    import os as _os
+                    d = _os.path.join(sys.argv[1], "scrub_extra")
+                    _os.makedirs(d, exist_ok=True)
+                    plane._extra = generate_synthetic_volume(
+                        _os.path.join(d, "99"), 99, n_needles=256,
+                        avg_size=128 * 1024, seed=3)
+                plane._stop = threading.Event()
+                plane._thr = threading.Thread(
+                    target=plane._loop, args=(plane._stop,),
+                    daemon=True)
+                plane._thr.start()
+            elif not enabled and plane._thr is not None:
+                plane._stop.set()
+                plane._thr.join()
+                plane._thr = None
 else:  # "faults": on = armed-but-inert spec, so every fault point in
     # the read path pays the real armed cost (dict lookup miss) while
     # injecting nothing; off = the disarmed single-flag fast path.
@@ -2061,6 +2127,61 @@ def child_ingress_overhead() -> None:
         f"off / {res['ingress_read_us_on']}us on -> "
         f"{res['ingress_overhead_pct']}% overhead "
         f"({'OK' if res['ingress_overhead_ok'] else 'OVER BUDGET'})")
+    _persist(res)
+    print(json.dumps(res), flush=True)
+
+
+def child_scrub_overhead() -> None:
+    """Paced-scrub foreground tax on the cached-read path
+    (docs/robustness.md "Scrub & repair").
+
+    Same paired-block harness as the observability stages; the stdin
+    toggle starts/stops a background thread CRC-walking the served
+    volume under the production token-bucket pacer (8 MiB/s), so the
+    difference is the steady-state cost a client read pays while a
+    scrub pass is in flight — the number the pacer exists to bound.
+    A second, in-process measurement scrubs a synthetic volume
+    UNPACED for the raw verification bandwidth (``scrub_gibps``),
+    the ceiling the pacer throttles down from.
+    Acceptance (ISSUE 20): paced overhead < 5%."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage import scrubber
+    from seaweedfs_tpu.storage.volume import generate_synthetic_volume
+
+    t_off, t_on = _measure_plane_overhead("scrub")
+    overhead = (t_on - t_off) / t_off
+
+    tmp = tempfile.mkdtemp(prefix="bench_scrub_raw_")
+    try:
+        svol = generate_synthetic_volume(
+            os.path.join(tmp, "5"), 5, n_needles=256,
+            avg_size=128 * 1024, seed=11)
+        t0 = time.perf_counter()
+        raw = scrubber.scrub_volume(svol)
+        dt = time.perf_counter() - t0
+        svol.close()
+        if raw["corrupt"]:
+            raise RuntimeError("scrub flagged a pristine volume")
+        gibps = raw["bytes"] / dt / (1 << 30)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    res = {
+        "scrub_overhead_pct": round(overhead * 100, 2),
+        "scrub_read_us_off": round(t_off * 1e6, 1),
+        "scrub_read_us_on": round(t_on * 1e6, 1),
+        "scrub_overhead_ok": bool(overhead < 0.05),
+        "scrub_gibps": round(gibps, 3),
+        "scrub_raw_mib": round(raw["bytes"] / MIB, 1),
+    }
+    log(f"scrub stage: cached read {res['scrub_read_us_off']}us "
+        f"off / {res['scrub_read_us_on']}us on -> "
+        f"{res['scrub_overhead_pct']}% overhead "
+        f"({'OK' if res['scrub_overhead_ok'] else 'OVER BUDGET'}); "
+        f"raw verify {res['scrub_gibps']} GiB/s over "
+        f"{res['scrub_raw_mib']} MiB")
     _persist(res)
     print(json.dumps(res), flush=True)
 
@@ -2722,6 +2843,9 @@ if __name__ == "__main__":
     elif ("--child-ingress-overhead" in sys.argv
           or "--ingress-overhead" in sys.argv):
         child_ingress_overhead()
+    elif ("--child-scrub-overhead" in sys.argv
+          or "--scrub-overhead" in sys.argv):
+        child_scrub_overhead()
     elif "--child-sim" in sys.argv:
         child_sim()
     elif "--child-ckpt" in sys.argv:

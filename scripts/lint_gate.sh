@@ -137,6 +137,22 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# Crash-consistency smoke (docs/robustness.md "Crash consistency"):
+# randomized torn-write crash injection across the crashpoint catalog
+# (append/vacuum/EC-encode/ckpt-save); recovery must serve every
+# acknowledged write byte-identical with zero client-visible
+# corruption across all replayed post-crash disk states.
+bash scripts/crash_smoke.sh
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo >&2
+    echo "lint_gate: crash_smoke failed (exit $rc) — recovery served" \
+         "corrupt or lost an acknowledged write after a simulated" \
+         "power cut; see scripts/crash_smoke.sh (the printed master" \
+         "seed reproduces it)" >&2
+    exit "$rc"
+fi
+
 # Flight-recorder smoke (docs/pipeline.md "Flight recorder"): an
 # armed-recorder encode must stay byte-identical to a recorder-off
 # encode, pipeline.analyze must produce a bottleneck verdict, and the

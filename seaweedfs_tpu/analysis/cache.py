@@ -145,6 +145,7 @@ def store(cache_path: Path, version: str, keys: dict[str, list[int]],
     try:
         tmp.write_text(json.dumps(data, default=_jsonable),
                        encoding="utf-8")
+        # seaweedlint: disable=SW901 — pure-speedup cache; losing it re-lints, fsync would slow every run
         os.replace(tmp, cache_path)
     except OSError:
         # cache writes are pure speedup — a read-only checkout or a

@@ -13,6 +13,7 @@ from pathlib import Path
 
 from .buffer_rules import check_buffers
 from .dataflow import build_flows
+from .durability_rules import check_durability
 from .findings import Finding, fingerprint_findings, is_suppressed
 from .jax_rules import check_jax
 from .local_rules import check_local
@@ -107,6 +108,8 @@ def analyze_sources(sources: dict[str, str],
     findings.extend(timed("SW6xx net", lambda: check_net(fp, sources)))
     findings.extend(timed("SW7xx jax", lambda: check_jax(modules)))
     findings.extend(timed("SW8xx races", lambda: check_races(fp)))
+    findings.extend(timed("SW9xx durability",
+                          lambda: check_durability(modules)))
 
     def finish():
         kept = []

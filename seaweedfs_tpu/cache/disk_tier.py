@@ -30,6 +30,7 @@ import time
 from pathlib import Path
 from typing import Iterator, Optional
 
+from ..util import durability, faults
 from .readahead import METRICS as _SEAWEED_METRICS
 
 #: magic(1) flags(1) key_len(2) volume(4) data_len(4) expires_epoch(8)
@@ -170,6 +171,7 @@ class DiskTier:
         evicted = 0
         with self._lock:
             if self._sizes[self._active] + rec_len > self.segment_cap:
+                # seaweedlint: disable=SW103 — sleep only via an armed test-harness delay fault at the crashpoint, never in production
                 evicted = self._rotate()
             # seaweedlint: disable=SW103 — the tier lock's whole job is serializing this cache file; the append must see the post-rotation handle
             self._append_locked(key, kb, data, volume, float(expires))
@@ -186,7 +188,12 @@ class DiskTier:
         f.write(kb)
         data_off = self._sizes[i] + _HEADER.size + 4 + len(kb)
         f.write(data)
-        f.flush()
+        faults.check("crash.disktier.append")
+        # commit barrier ([storage] fsync policy): a flushed-not-synced
+        # record a restart scan finds could be a torn lie after power
+        # loss; the scan's tail-truncation handles the un-synced case,
+        # but the barrier bounds how much cached data a crash sheds
+        durability.barrier(f, _HEADER.size + 4 + len(kb) + len(data))
         self._sizes[i] += _HEADER.size + 4 + len(kb) + len(data)
         self._index[key] = _IndexEntry(i, data_off, len(data),
                                        volume, expires)

@@ -40,7 +40,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..util import glog, tracing
+from ..util import faults, glog, tracing
 from .manifest import (Manifest, ManifestError, ParamSpec,
                        ShardEntry, spec_from_json, spec_to_json)
 from .s3client import GatewayClient
@@ -137,6 +137,10 @@ class CheckpointStore:
                 man = self._merge_parts(root, nproc)
                 man.finalize()
                 man.validate()
+                # the manifest PUT is the checkpoint's rename-style
+                # commit point: a crash on either side leaves a fully
+                # readable prior state (no manifest = no checkpoint)
+                faults.check("crash.ckpt.save")
                 self.client.put(self.bucket, f"{root}/{self.MANIFEST}",
                                 man.to_json(), "application/json")
                 glog.info("ckpt: committed %s (%d params, %d procs)",

@@ -652,6 +652,8 @@ def main(argv: list[str]) -> int:
     tracing.configure_from(conf)
     retry.configure_from(conf)
     faults_mod.configure_from(conf)
+    from ..util import durability as durability_mod
+    durability_mod.configure_from(conf)
     profiler.configure_from(conf)
     usage_mod.configure_from(conf)
     httpserver.configure_from(conf)

@@ -5,8 +5,8 @@ process driving every rpc itself; a pod-scale sweep then bottlenecks on
 (and dies with) that one coordinator. This module moves the work-list
 into the master (ROADMAP "pod-scale EC sweeps"): a :class:`JobManager`
 holds durable per-volume tasks (``ec_encode``, ``ec_rebuild``,
-``vacuum``, ``replicate``, ``replica_drop``) that volume servers pull
-with **leases** —
+``vacuum``, ``replicate``, ``replica_drop``, ``scrub``) that volume
+servers pull with **leases** —
 
 - a worker claims a task over HTTP (``POST /cluster/jobs/claim``,
   leader-proxied like every /cluster/* write);
@@ -49,7 +49,8 @@ from ..util import glog, retry
 from ..util.stats import Metrics
 
 #: Task kinds the manager accepts and workers know how to execute.
-KINDS = ("ec_encode", "ec_rebuild", "vacuum", "replicate", "replica_drop")
+KINDS = ("ec_encode", "ec_rebuild", "vacuum", "replicate", "replica_drop",
+         "scrub")
 
 #: Kinds that change what a volume's bytes mean — their commits fan a
 #: cache-invalidation event out to every subscribed gateway cache.
@@ -259,7 +260,9 @@ class JobManager:
         holds = (t.collection, t.volume_id) in node.volumes
         if t.kind in ("ec_encode", "vacuum", "replica_drop"):
             return holds
-        if t.kind == "ec_rebuild":
+        if t.kind in ("ec_rebuild", "scrub"):
+            # scrub covers both forms: a node scrubs the needles it
+            # holds and/or the EC shards it hosts
             return holds or (t.collection, t.volume_id) in node.ec_shards
         if t.kind == "replicate":
             return (not holds) and node.free_slots > 0
@@ -947,6 +950,8 @@ class JobWorker:
         elif kind == "replica_drop":
             vs.store.delete_volume(vid, col)
             vs.heartbeat_now()
+        elif kind == "scrub":
+            self._run_scrub(vid, col, task.get("params") or {})
         else:
             raise JobError(f"unknown task kind {kind!r}")
 
@@ -982,6 +987,59 @@ class JobWorker:
         if params.get("drop_source"):
             vs.store.delete_volume(vid, col)
         vs.heartbeat_now()
+
+    def _run_scrub(self, vid: int, col: str, params: dict) -> None:
+        """Paced integrity pass over whatever of volume ``vid`` lives
+        here: live needles CRC-walked (corrupt ones repaired from a
+        replica over ReadNeedleBlob), EC shards hash-verified against
+        their sidecar baseline (corrupt ones quarantined + rebuilt
+        from survivors). One pacer spans both so the configured byte
+        rate is a per-volume-task cap, not per-form."""
+        from ..storage import scrubber
+        vs = self.vs
+        rate = params.get("rate_bytes_per_second")
+        pacer = scrubber.RatePacer(
+            int(rate) if rate is not None else None)
+        did_any = False
+        if vs.store.has_volume(vid, col):
+            vol = vs.store.get_volume(vid, col)
+
+            def _fetch(key: int):
+                for peer in vs.replica_peers(vid, col):
+                    try:
+                        blob = vs.peer_stub(peer).ReadNeedleBlob(
+                            volume_server_pb2.ReadNeedleBlobRequest(
+                                volume_id=vid, collection=col,
+                                needle_id=key))
+                        if blob.needle_blob:
+                            return bytes(blob.needle_blob)
+                    except Exception as e:  # noqa: BLE001 — try next peer
+                        glog.v(1, "scrub: peer %s fetch of needle %d "
+                               "failed: %s", peer, key, e)
+                return None
+
+            r = scrubber.scrub_volume(
+                vol, pacer, fetch_record=_fetch,
+                progress=lambda f: self.set_fraction(0.5 * f))
+            glog.info("jobs: scrubbed volume %d [%s]: %s", vid, col,
+                      {k: v for k, v in r.items() if k != "quarantined"})
+            did_any = True
+        mount = vs.store.ec_mounts.get((col, vid))
+        if mount is not None:
+            from .volume_server import _scheme_from_vif
+            r = scrubber.scrub_ec(
+                mount.base, _scheme_from_vif(mount.base), pacer,
+                progress=lambda f: self.set_fraction(0.5 + 0.5 * f))
+            glog.info("jobs: scrubbed EC volume %d [%s]: %s", vid, col,
+                      {k: v for k, v in r.items() if k != "quarantined"})
+            # no cache fan-out on repair: a rebuilt shard is verified
+            # byte-identical to the baseline, so cached decodes stay
+            # right (rebuild_ec_files already invalidates locally)
+            did_any = True
+        if not did_any:
+            raise JobError(f"scrub volume {vid}: neither volume nor "
+                           f"EC shards present on {vs.url}")
+        self.set_fraction(1.0)
 
     # ---------------- heartbeat piggyback / views ----------------
 

@@ -320,16 +320,19 @@ class MasterServer:
         """Enumerate the work-list for a whole-collection submission
         (``job.submit ec.encode -collection X`` names no volumes):
         ec_encode targets plain volumes not yet EC'd, ec_rebuild
-        targets EC volumes, the rest every plain volume."""
+        targets EC volumes, scrub both forms (integrity is universal),
+        the rest every plain volume."""
         plain: set[int] = set()
         for node in self.topology.snapshot_nodes():
             for (col, vid) in node.volumes:
                 if col == collection:
                     plain.add(vid)
+        ec = {vid for vid, col in self.topology.ec_collections.items()
+              if col == collection}
         if kind == "ec_rebuild":
-            return sorted(
-                vid for vid, col in self.topology.ec_collections.items()
-                if col == collection)
+            return sorted(ec)
+        if kind == "scrub":
+            return sorted(plain | ec)
         if kind == "ec_encode":
             plain -= set(self.topology.ec_locations)
         return sorted(plain)
@@ -931,6 +934,22 @@ def _make_http_handler(ms: MasterServer):
                         limit=int(q.get("limit", 1000)) or None)
                     doc["policy"] = ms.policy.payload()
                     self._json(doc)
+                elif u.path == "/cluster/scrub":
+                    # Scrub-plane view: the scrub jobs (a filtered
+                    # /cluster/jobs) plus the candidate volume count,
+                    # so operators see coverage at a glance.
+                    if self._proxy_to_leader():
+                        return
+                    doc = ms.jobs.to_map(
+                        with_tasks=q.get("tasks", "1") != "0",
+                        limit=int(q.get("limit", 1000)) or None)
+                    scrub_jobs = [j for j in doc["jobs"]
+                                  if j["kind"] == "scrub"]
+                    self._json({
+                        "enabled": doc["enabled"],
+                        "jobs": scrub_jobs,
+                        "candidates": len(ms.job_candidate_volumes(
+                            "scrub", q.get("collection", "")))})
                 elif u.path == "/cluster/slo":
                     if self._proxy_to_leader():
                         return
@@ -1082,6 +1101,29 @@ def _make_http_handler(ms: MasterServer):
                         self._json({"error": "not found"}, 404)
                 except KeyError as e:
                     self._json({"error": str(e.args[0])}, 404)
+                except (ValueError, OSError) as e:
+                    self._json({"error": str(e)}, 400)
+            elif u.path == "/cluster/scrub":
+                # Convenience submit: a scrub job over the named
+                # volumes (or every plain + EC volume of the
+                # collection when none are named).
+                if self._proxy_to_leader():
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    col = str(body.get("collection", ""))
+                    vids = body.get("volumes") or \
+                        ms.job_candidate_volumes("scrub", col)
+                    params = dict(body.get("params") or {})
+                    if body.get("rate_bytes_per_second") is not None:
+                        params["rate_bytes_per_second"] = int(
+                            body["rate_bytes_per_second"])
+                    self._json({"job": ms.jobs.submit(
+                        "scrub", vids, collection=col, params=params,
+                        parallel=int(body.get("parallel", 0)),
+                        submitted_by=str(
+                            body.get("submittedBy", "http")))})
                 except (ValueError, OSError) as e:
                     self._json({"error": str(e)}, 400)
             elif u.path == "/cluster/cache_subscribe":

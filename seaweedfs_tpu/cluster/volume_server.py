@@ -39,8 +39,8 @@ from ..storage.store import Store, StoreError
 from ..storage.superblock import ReplicaPlacement, Ttl
 from ..storage.types import FileId
 from ..storage.volume import dat_path, idx_path
-from ..util import faults, glog, httpserver, profiler, retry, \
-    security, tracing, varz
+from ..util import durability, faults, glog, httpserver, profiler, \
+    retry, security, tracing, varz
 from ..util.stats import EXPOSITION_CONTENT_TYPE, Metrics
 from ..cache import invalidation as invalidation_mod
 from . import jobs as jobs_mod
@@ -972,7 +972,10 @@ def _copy_remote_file(vs: VolumeServer, src_url: str, volume_id: int,
     if ignore_missing and not got_any and tmp.stat().st_size == 0:
         tmp.unlink()
         return
-    tmp.replace(dest)
+    # durable rename commit: the copied replica/shard file must survive
+    # power loss once callers (ec.rebuild, volume copy) treat it as
+    # placed — fsync the bytes AND the directory entry
+    durability.durable_replace(tmp, dest)
 
 
 def _make_http_handler(vs: VolumeServer):
@@ -1009,10 +1012,12 @@ def _make_http_handler(vs: VolumeServer):
                             **vs.store.status()})
                 return
             if u.path == "/metrics":
+                from ..storage import scrubber as scrubber_mod
                 self._send(200, (vs.metrics.render()
                                  + tracing.METRICS.render()
                                  + retry.METRICS.render()
                                  + flight_mod.METRICS.render()
+                                 + scrubber_mod.METRICS.render()
                                  + httpserver.METRICS.render()).encode(),
                            EXPOSITION_CONTENT_TYPE)
                 return
@@ -1260,6 +1265,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     usage_mod.configure_from(conf)
     retry.configure_from(conf)
     faults.configure_from(conf)
+    durability.configure_from(conf)
+    from ..storage import scrubber as scrubber_mod
+    scrubber_mod.configure_from(conf)
     profiler.configure_from(conf)
     httpserver.configure_from(conf)
     profiler.ensure_started()

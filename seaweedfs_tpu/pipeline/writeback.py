@@ -28,7 +28,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from ..util import bufcheck, racecheck
+from ..util import bufcheck, faults, racecheck
 from . import flight
 
 #: Linux UIO_MAXIOV; one pwritev can scatter at most this many
@@ -168,6 +168,10 @@ class WriterPool:
         failed."""
         if self._errors:
             self._raise()
+        # crashpoint on the submitting thread (docs/robustness.md): a
+        # crash here models losing the process with shard slices
+        # already queued/retired but the encode not yet acknowledged
+        faults.check("crash.ec.writeback")
         fd = self._fds.get(path)
         if fd is None:
             raise WriterError(f"writeback: {path!r} not opened")

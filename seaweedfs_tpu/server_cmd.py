@@ -51,11 +51,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     conf = config_mod.load(args.config) if args.config else {}
     secret = config_mod.lookup(conf, "jwt.signing.key", "")
     tls_mod.install_from_config(conf)
+    from .util import durability as durability_mod
     from .util import faults as faults_mod
     from .util import profiler, retry, tracing
     tracing.configure_from(conf)
     retry.configure_from(conf)
     faults_mod.configure_from(conf)
+    durability_mod.configure_from(conf)
+    from .storage import scrubber as scrubber_mod
+    scrubber_mod.configure_from(conf)
     profiler.configure_from(conf)
     profiler.ensure_started()
 

@@ -333,7 +333,7 @@ DESTRUCTIVE_COMMANDS = {
     "s3.configure", "fs.configure", "s3.clean.uploads", "volume.fsck",
     "volume.mount", "volume.unmount",
     "volume.configure.replication",
-    "job.submit", "job.cancel",
+    "job.submit", "job.cancel", "scrub.start",
 }
 
 
@@ -2296,6 +2296,70 @@ def cmd_job_cancel(env: ClusterEnv, argv: list[str]) -> None:
     job = env._master_http(f"/cluster/jobs/cancel?job={args.job}",
                            method="POST")["job"]
     env.println(f"job {job['jobId']} {job['state']}")
+
+
+@cluster_command("scrub.start")
+def cmd_scrub_start(env: ClusterEnv, argv: list[str]) -> None:
+    """Start a paced integrity scrub: every targeted volume's live
+    needles are CRC-walked and its EC shards hash-verified on the
+    server that holds them, with corrupt data quarantined and
+    auto-repaired from replicas / parity (docs/robustness.md, "Scrub
+    & repair"). Defaults to every plain + EC volume of the
+    collection."""
+    p = _parser("scrub.start")
+    p.add_argument("-collection", default="")
+    p.add_argument("-volumeId", default="",
+                   help="comma-separated ids; default: every volume "
+                        "of the collection")
+    p.add_argument("-rate", type=int, default=0,
+                   help="byte read rate cap per task "
+                        "(0 = [storage.scrub] configured rate)")
+    p.add_argument("-parallel", type=int, default=0,
+                   help="max concurrently leased tasks (0 = unlimited)")
+    p.add_argument("-wait", action="store_true",
+                   help="block until the scrub reaches a terminal "
+                        "state")
+    args = p.parse_args(argv)
+    body = {"collection": args.collection,
+            "volumes": [int(x) for x in args.volumeId.split(",") if x],
+            "parallel": args.parallel, "submittedBy": "shell"}
+    if args.rate > 0:
+        body["rate_bytes_per_second"] = args.rate
+    doc = env._master_http("/cluster/scrub", method="POST", body=body)
+    job = doc["job"]
+    env.println(f"scrub {job['jobId']}: {job['total']} volume(s) "
+                f"queued")
+    if args.wait:
+        job = _wait_for_job(env, job["jobId"])
+        if job["state"] != "done":
+            raise ShellError(f"scrub {job['jobId']} {job['state']}")
+
+
+@cluster_command("scrub.status")
+def cmd_scrub_status(env: ClusterEnv, argv: list[str]) -> None:
+    """Show the scrub plane: each scrub job's per-volume task states
+    and the candidate count still uncovered."""
+    p = _parser("scrub.status")
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    doc = env._master_http(
+        f"/cluster/scrub?collection={args.collection}")
+    jobs = doc.get("jobs", ())
+    if not jobs:
+        env.println("no scrub jobs")
+    for j in jobs:
+        counts = ", ".join(f"{n} {s}" for s, n in
+                           sorted(j.get("taskCounts", {}).items()))
+        env.println(f"{j['jobId']}: [{j['collection'] or 'default'}] "
+                    f"{j['state']} ({counts or 'empty'})")
+        for t in j.get("tasks", ()):
+            if t["state"] in ("leased", "failed"):
+                err = f"  {t['error']}" if t["error"] else ""
+                env.println(
+                    f"  {t['taskId']}: volume {t['volumeId']} "
+                    f"{t['state']} ({t['fraction']:.0%} on "
+                    f"{t['worker'] or '-'}){err}")
+    env.println(f"candidate volumes: {doc.get('candidates', 0)}")
 
 
 def run_cluster_command(env: ClusterEnv, line: str) -> None:

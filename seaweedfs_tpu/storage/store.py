@@ -135,8 +135,23 @@ class Store:
     def load_existing(self) -> None:
         """Scan every location and open what's on disk (volume_loading.go;
         EC shards found with their .ecx are auto-mounted the way the
-        reference remounts shards on restart)."""
+        reference remounts shards on restart). Before opening anything,
+        sweep orphaned transfer temporaries — ``.part`` streams (tier
+        downloads, replica copies killed mid-transfer) and ``.tmp``
+        sidecar writes — whose rename commit point never ran; they are
+        garbage by construction (the commit is the rename) and a later
+        transfer restarts from scratch."""
         for loc in self.locations:
+            removed = 0
+            for pattern in ("*.part", "*.tmp"):
+                for orphan in loc.directory.glob(pattern):
+                    orphan.unlink(missing_ok=True)
+                    removed += 1
+            if removed:
+                from ..util import glog
+                glog.info("store: removed %d orphaned transfer "
+                          "temporaries under %s", removed,
+                          loc.directory)
             for col, vid, base in loc.scan_volumes():
                 if (col, vid) not in self.volumes:
                     vol = Volume(base, vid, backend=self.backend,

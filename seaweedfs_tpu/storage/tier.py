@@ -30,7 +30,7 @@ from dataclasses import asdict, dataclass
 from pathlib import Path
 from typing import Optional
 
-from ..util import faults, retry
+from ..util import durability, faults, retry
 
 TIER_SUFFIX = ".tier"
 BLOCK = 1024 * 1024
@@ -71,7 +71,10 @@ class TierInfo:
         d.pop("secret_key", None)
         tmp.write_text(json.dumps(d, indent=1))
         os.chmod(tmp, 0o600)
-        os.replace(tmp, p)
+        # durable rename: the sidecar is the marker that the S3 copy is
+        # authoritative — losing it to a power cut while keeping the
+        # (possibly stale-tracked) local .dat would fork the truth
+        durability.durable_replace(tmp, p)
 
     @classmethod
     def maybe_load(cls, base: str | Path) -> Optional["TierInfo"]:
@@ -333,5 +336,8 @@ def download_volume_dat(base: str | Path,
         part.unlink()
         raise TierError(f"tier download size mismatch: got {got}, "
                         f"sidecar says {info.size}")
-    os.replace(part, dat)
+    faults.check("crash.tier.download")
+    # already fsynced above; the parent-dir fsync in durable_replace is
+    # what makes the rename itself survive power loss
+    durability.durable_replace(part, dat, fsync_src=False)
     TierInfo.path_for(base).unlink()

@@ -29,6 +29,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
 
+from ..util import durability, faults
 from . import backend as backend_mod
 from . import needle as needle_mod
 from .idx import CompactMap, IndexEntry, walk_index_blob
@@ -117,6 +118,7 @@ def _compact_locked(vol: Volume) -> CompactState:
             open(cpx_path(vol.base), "wb") as nx:
         nd.write(new_super.to_bytes())
         _copy_live(snap, vol._dat, vol.super_block.version, nd, nx)
+        faults.check("crash.vacuum.compact")
         nd.flush()
         os.fsync(nd.fileno())
         nx.flush()
@@ -235,11 +237,16 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
         nx.flush()
         os.fsync(nx.fileno())
     # Swap: close handles, rename .cpd/.cpx over .dat/.idx (dat
-    # first; load-time checking tolerates a torn pair), reopen.
+    # first; load-time checking tolerates a torn pair), reopen. The
+    # renames are durable_replace — fsyncing the parent directory is
+    # what persists the swap itself; without it a power cut after
+    # "commit" could resurrect the garbage-laden pre-compact files.
     vol._dat.close()
     vol._idx.close()
+    faults.check("crash.vacuum.precommit")
     try:
-        os.replace(cpd_path(vol.base), dat_path(vol.base))
+        durability.durable_replace(cpd_path(vol.base),
+                                   dat_path(vol.base))
     except OSError:
         # Nothing swapped yet: reopen the untouched live files so the
         # volume stays serviceable; abort_compact discards .cpd/.cpx.
@@ -252,8 +259,10 @@ def _commit_swap_drained(vol: Volume, state: CompactState) -> int:
         # seaweedlint: disable=SW801 — same swap-drain protocol
         vol._idx = open(idx_path(vol.base), "a+b")
         raise
+    faults.check("crash.vacuum.midcommit")
     try:
-        os.replace(cpx_path(vol.base), idx_path(vol.base))
+        durability.durable_replace(cpx_path(vol.base),
+                                   idx_path(vol.base))
     except OSError:
         # Torn commit: the compacted .dat is live and .cpx is its only
         # index. Keep .cpx on disk (cleanup() preserves this state) and

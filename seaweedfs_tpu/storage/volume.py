@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..util import durability, faults
 from . import backend as backend_mod
 from . import needle as needle_mod
 from .idx import CompactMap, IndexEntry, walk_index_blob
@@ -172,7 +173,7 @@ class Volume:
         cpd = Path(str(self.base) + ".cpd")
         cpx = Path(str(self.base) + ".cpx")
         if cpx.exists() and not cpd.exists():
-            os.replace(cpx, idx_path(self.base))
+            durability.durable_replace(cpx, idx_path(self.base))
         else:
             for leftover in (cpd, cpx):
                 if leftover.exists():
@@ -297,12 +298,16 @@ class Volume:
             rec = n.to_bytes(self.super_block.version)
             body_size = needle_mod.parse_header(rec)[2]
             self._dat.write_at(rec, offset)
-            # Flush to the OS so concurrent reads see the record the
-            # moment the index entry is visible.
-            self._dat.flush()
+            faults.check("crash.append.dat")  # seaweedlint: disable=SW103 — faults.check sleeps only under an armed test-harness delay spec, never in production
+            # The barrier flushes (concurrent reads see the record the
+            # moment the index entry is visible) and fsyncs per the
+            # [storage] policy: under `commit`, the ack this method
+            # returns means the needle survives power loss.
+            durability.barrier(self._dat, len(rec))
             units = to_offset_units(offset)
             self._idx.write(IndexEntry(n.id, units, body_size).to_bytes())
-            self._idx.flush()
+            faults.check("crash.append.idx")  # seaweedlint: disable=SW103 — faults.check sleeps only under an armed test-harness delay spec, never in production
+            durability.barrier(self._idx, NEEDLE_MAP_ENTRY_SIZE)
             self.nm.set(n.id, units, body_size)
         return offset
 
@@ -387,10 +392,12 @@ class Volume:
                 self._dat.write_at(b"\x00" * pad, offset)
                 offset += pad
             self._dat.write_at(rec, offset)
-            self._dat.flush()
+            faults.check("crash.append.dat")  # seaweedlint: disable=SW103 — faults.check sleeps only under an armed test-harness delay spec, never in production
+            durability.barrier(self._dat, len(rec))
             units = to_offset_units(offset)
             self._idx.write(IndexEntry(key, units, body_size).to_bytes())
-            self._idx.flush()
+            faults.check("crash.append.idx")  # seaweedlint: disable=SW103 — faults.check sleeps only under an armed test-harness delay spec, never in production
+            durability.barrier(self._idx, NEEDLE_MAP_ENTRY_SIZE)
             self.nm.set(key, units, body_size)
         return offset
 
@@ -405,7 +412,7 @@ class Volume:
                 return False
             self._idx.write(
                 IndexEntry(key, 0, TOMBSTONE_FILE_SIZE).to_bytes())
-            self._idx.flush()
+            durability.barrier(self._idx, NEEDLE_MAP_ENTRY_SIZE)
         return True
 
     def configure_replication(self, replication: str) -> None:
@@ -423,7 +430,8 @@ class Volume:
                     f"download it first")
             self.super_block.replica_placement = rp
             self._dat.write_at(self.super_block.to_bytes(), 0)
-            self._dat.flush()
+            durability.barrier(self._dat,
+                               len(self.super_block.to_bytes()))
 
     def sync(self) -> None:
         with self._lock:
@@ -448,12 +456,21 @@ def check_volume_data_integrity(base: str | Path,
 
     The reference's volume_checking.go verifies the LAST index entry's
     needle and refuses the volume on mismatch; here torn tails are
-    REPAIRED instead (the write order is dat-then-idx, so the tail is
-    always the casualty): a partial trailing .idx entry is truncated, a
-    trailing .idx entry whose record is missing/short/mismatched in the
-    .dat is dropped, and .dat bytes past the last journaled record (a
-    torn append that never reached the index) are truncated. Returns a
-    dict of repairs performed (empty = clean)."""
+    REPAIRED instead (the write order is dat-then-idx-then-ack, with a
+    durability barrier between each under the default ``[storage]
+    fsync = "commit"`` policy, so only un-acknowledged tail records can
+    be casualties): a partial trailing .idx entry is truncated, a
+    trailing .idx entry whose record is missing/short/mismatched/CRC-
+    torn in the .dat is dropped, and .dat bytes past the last journaled
+    record (a torn append that never reached the index) are truncated.
+    Trailing records are validated by full checksum walk-back — a
+    crash can persist a record's header sectors without its body, so
+    header-only validation would let a torn needle back into the map.
+    Mid-file records behind the first valid tail entry were barriered
+    before their successors were acknowledged and are not re-read here;
+    read-time CRC verification and the background scrub
+    (storage/scrubber.py) guard those against bit-rot. Returns a dict
+    of repairs performed (empty = clean)."""
     repairs: dict[str, int] = {}
     ip, dp = idx_path(base), dat_path(base)
     dat_size = dp.stat().st_size
@@ -480,12 +497,15 @@ def check_volume_data_integrity(base: str | Path,
             if e.is_deleted:
                 pos -= NEEDLE_MAP_ENTRY_SIZE
                 continue
-            end = e.byte_offset + needle_mod.record_size(e.size, version)
+            rec_len = needle_mod.record_size(e.size, version)
+            end = e.byte_offset + rec_len
             ok = False
             if end <= dat_size:
-                hdr = os.pread(dat_fd, NEEDLE_HEADER_SIZE, e.byte_offset)
+                rec = os.pread(dat_fd, rec_len, e.byte_offset)
                 try:
-                    _, nid, nsize = needle_mod.parse_header(hdr)
+                    _, nid, nsize = needle_mod.parse_header(rec)
+                    # full parse = checksum verification of the body
+                    needle_mod.Needle.parse(rec, version)
                     ok = nid == e.key and nsize == e.size
                 except needle_mod.NeedleError:
                     ok = False
@@ -503,6 +523,7 @@ def check_volume_data_integrity(base: str | Path,
         blob = blob[:idx_size]
         with open(ip, "r+b") as f:
             f.truncate(idx_size)
+            os.fsync(f.fileno())  # a repair is itself a commit point
     # The true append frontier is the max record end over every
     # journaled (non-tombstone) entry — deleted needles' bytes are still
     # in the file; anything beyond is a torn append.
@@ -516,6 +537,7 @@ def check_volume_data_integrity(base: str | Path,
     if dat_size > frontier:
         with open(dp, "r+b") as df:
             df.truncate(frontier)
+            os.fsync(df.fileno())
         repairs["dat_truncated_bytes"] = dat_size - frontier
     return repairs
 

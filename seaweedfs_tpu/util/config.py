@@ -202,6 +202,22 @@ fast_window_seconds = 300.0      # paired with fast_long_window
 fast_long_window_seconds = 3600.0
 slow_window_seconds = 21600.0
 """,
+    "storage": """\
+# storage.toml — durability + scrub policy (docs/robustness.md).
+# fsync: "commit" = every acknowledged write is fsynced (an ack means
+# the bytes survive power loss); "batch" = group commits by bytes/age
+# (bounded loss window); "off" = flush to the OS only (process-crash
+# safe, not power-loss safe — the pre-durability-sweep behavior).
+[storage]
+fsync = "commit"
+fsync_batch_bytes = 8388608      # batch mode: fsync every 8 MiB
+fsync_batch_seconds = 1.0        # ... or every second, whichever first
+
+# Background scrub (docs/robustness.md "Scrub & repair"): re-read data
+# at rest, verify CRC/parity, quarantine + repair silent corruption.
+[storage.scrub]
+rate_bytes_per_second = 8388608  # token-bucket pacing (0 = unpaced)
+""",
     "faults": """\
 # faults.toml — deterministic fault injection (docs/robustness.md).
 # Spec syntax: action[@probability][:param][#count], e.g.

@@ -18,6 +18,8 @@ A fault *spec* is a compact string::
     delay:0.2@0.5    ... on a seeded coin-flip half the time
     truncate:0.5     mangle() returns the first half of the bytes
     corrupt          mangle() flips bytes at seeded positions
+    crash            power-cut the process at the point (os._exit), or
+                     raise SimulatedCrash under a crashfs recording
     error@0.3#5      30% of calls, at most 5 injections total
 
 Coin flips come from a per-spec ``random.Random`` seeded from the
@@ -59,6 +61,19 @@ CATALOG = (
     "sink.s3",         # replication S3 sink pushes
     "notify.webhook",  # notification webhook POSTs
     "tier.copy",       # volume tier upload/download transfers
+    # Crashpoints (docs/robustness.md "Crash consistency"): named
+    # commit-path instants where a `crash` spec kills the process (or,
+    # under util/crashfs.py, raises SimulatedCrash and freezes the
+    # recorded op log for torn-prefix replay).
+    "crash.append.dat",      # needle appended to .dat, .idx not yet
+    "crash.append.idx",      # .idx journaled, ack not yet returned
+    "crash.vacuum.compact",  # mid-compact: .cpd/.cpx partially built
+    "crash.vacuum.precommit",  # compact done, neither rename applied
+    "crash.vacuum.midcommit",  # .cpd renamed over .dat, .cpx not yet
+    "crash.disktier.append",   # disk-cache segment record written
+    "crash.tier.download",     # .dat.part complete, not yet renamed
+    "crash.ckpt.save",         # shards written, manifest not yet PUT
+    "crash.ec.writeback",      # EC shard slice positioned-write issued
 )
 
 
@@ -81,7 +96,7 @@ class FaultSpec:
     __slots__ = ("point", "action", "probability", "param", "remaining",
                  "spec", "rng", "hits")
 
-    ACTIONS = ("error", "drop", "delay", "truncate", "corrupt")
+    ACTIONS = ("error", "drop", "delay", "truncate", "corrupt", "crash")
 
     def __init__(self, point: str, spec: str, seed: Optional[int] = None):
         self.point = point
@@ -144,6 +159,10 @@ class FaultSpec:
 
 _LOCK = threading.Lock()
 _SPECS: dict[str, FaultSpec] = {}
+#: Installed by util/crashfs.py while a crash recording is active: a
+#: callable(point) expected to raise (SimulatedCrash). When None, a
+#: fired `crash` spec hard-exits the process (os._exit) instead.
+_CRASH_HANDLER = None
 _SEED = 0
 _ENABLED = True
 #: Hot-path flag: True only when enabled AND at least one spec is
@@ -228,6 +247,14 @@ def active() -> bool:
     return _ACTIVE
 
 
+def set_crash_handler(handler) -> None:
+    """Route fired `crash` specs to ``handler(point)`` instead of
+    ``os._exit``. crashfs installs one for in-process torn-prefix
+    simulation; pass None to restore process-exit semantics."""
+    global _CRASH_HANDLER
+    _CRASH_HANDLER = handler
+
+
 def debug_payload() -> dict:
     """The faults section of ``/debug/vars``."""
     return {"enabled": _ENABLED, "seed": _SEED, "specs": specs()}
@@ -248,6 +275,14 @@ def check(point: str) -> None:
         time.sleep(fs.param)
     elif fs.action == "drop":
         raise FaultDrop(f"injected drop at {point}")
+    elif fs.action == "crash":
+        handler = _CRASH_HANDLER
+        if handler is not None:
+            handler(point)  # in-process simulation (util/crashfs.py)
+        # Real crash semantics: no atexit, no finally blocks, no
+        # buffered-file flushes — exactly what power loss looks like
+        # to everything this process had not fsynced.
+        os._exit(86)
     else:
         raise FaultError(f"injected fault at {point}")
 

@@ -94,6 +94,31 @@ def pytest_configure(config):
                    "(select with -m slow)")
 
 
+# ---------------------------------------------------------------------------
+# Durability policy for tests.
+#
+# The production default is fsync-on-commit, but paying two fsyncs per
+# appended needle turns write-heavy race tests into multi-minute runs
+# on slow disks (tests/test_vacuum_races.py spins writer threads for
+# five whole compact cycles). Tests exercise the append/compact logic,
+# not the disk's flush latency, so run the suite in "off" mode — the
+# pre-durability-policy behavior. Crash-consistency tests that DO need
+# the fsync semantics opt back in per-test (tests/test_crashfs.py's
+# autouse fixture runs after this one and wins).
+# ---------------------------------------------------------------------------
+
+import pytest  # noqa: E402
+
+from seaweedfs_tpu.util import durability  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fast_test_durability():
+    durability.configure(mode="off")
+    yield
+    durability.configure(mode="off")
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     viols = lockcheck.violations()
     if viols:

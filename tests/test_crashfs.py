@@ -1,0 +1,335 @@
+"""Crash-consistency: torn-write injection, replay, recovery invariants.
+
+The contract under test (docs/robustness.md "Crash consistency"):
+after a simulated power cut at any crashpoint, recovery must bring the
+volume back to a state where
+
+- every ACKNOWLEDGED write is served byte-identical (an ack under the
+  ``commit`` fsync policy is a durability promise);
+- the in-flight write is all-or-nothing: absent, or fully valid —
+  a torn needle is never served;
+- no vacuum/encode leftovers (``.cpd``/``.cpx``, partial shards)
+  resurrect stale data or block the volume from loading.
+
+Each test records a workload under :class:`CrashRecorder`, fires a
+``crash`` fault at a named crashpoint, then replays several legal
+post-crash disk states (different seeds = different page-cache drain
+orders, drops and sector tears) and runs real recovery —
+``Volume.load()`` — against each.
+"""
+
+import os
+import urllib.error
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ckpt.manifest import ManifestError
+from seaweedfs_tpu.ckpt.store import CheckpointStore
+from seaweedfs_tpu.pipeline.encode import encode_volume
+from seaweedfs_tpu.pipeline.scheme import EcScheme
+from seaweedfs_tpu.storage import needle as needle_mod
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.idx import IndexEntry
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.superblock import SuperBlock
+from seaweedfs_tpu.storage.volume import (Volume, dat_path,
+                                          generate_synthetic_volume,
+                                          idx_path)
+from seaweedfs_tpu.util import durability, faults
+from seaweedfs_tpu.util.crashfs import CrashRecorder, SimulatedCrash
+
+SCHEME = EcScheme(data_shards=10, parity_shards=4,
+                  large_block_size=2048, small_block_size=256)
+
+REPLAY_SEEDS = range(6)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_fault_state():
+    durability.configure(mode="commit")
+    faults.clear()
+    yield
+    faults.clear()
+    faults.set_crash_handler(None)
+
+
+def _needle_data(i: int) -> bytes:
+    return bytes((i * 37 + j) % 256 for j in range(90 + 17 * i))
+
+
+def _assert_all_served(vol: Volume, want: dict) -> None:
+    for key, data in want.items():
+        assert vol.read_needle(key).data == data, f"needle {key}"
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_fsync_is_a_promise_volatile_tail_is_not(tmp_path):
+    root = tmp_path / "d"
+    root.mkdir()
+    rec = CrashRecorder(root)
+    with rec:
+        with open(root / "f", "wb") as f:
+            f.write(b"A" * 512)
+            f.flush()
+            os.fsync(f.fileno())     # durable from here on
+            f.write(b"B" * 512)
+            f.write(b"C" * 512)      # volatile tail
+    for seed in REPLAY_SEEDS:
+        dest = rec.replay(tmp_path / f"r{seed}", seed=seed)
+        data = (dest / "f").read_bytes()
+        # the fsynced prefix always survives; the tail is a legal
+        # subset (possibly torn at a sector, possibly reordered away)
+        assert data[:512] == b"A" * 512
+        assert len(data) <= 1536
+    rec.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# append crashpoints
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ["crash.append.dat",
+                                   "crash.append.idx"])
+def test_append_crash_acked_needles_survive_any_replay(tmp_path, point):
+    root = tmp_path / "disk"
+    root.mkdir()
+    acked = {}
+    inflight = b"\xAB" * 700
+    rec = CrashRecorder(root)
+    with rec:
+        # created INSIDE the recording: the volume's fds register with
+        # the recorder, so every pwrite/fsync of the workload is logged
+        vol = Volume(root / "1", 1, SuperBlock()).create()
+        for i in range(1, 13):
+            acked[i] = _needle_data(i)
+            vol.write_needle(Needle(cookie=0xC0 + i, id=i,
+                                    data=acked[i]))
+        faults.inject(point, "crash#1")
+        with pytest.raises(SimulatedCrash):
+            vol.write_needle(Needle(cookie=1, id=99, data=inflight))
+    assert rec.crashed and rec.crash_point == point
+    vol.close()
+    for seed in REPLAY_SEEDS:
+        dest = rec.replay(tmp_path / f"r{seed}", seed=seed)
+        rvol = Volume(dest / "1", 1).load()
+        _assert_all_served(rvol, acked)
+        # in-flight write: all-or-nothing, never torn
+        try:
+            got = rvol.read_needle(99)
+        except KeyError:
+            pass
+        else:
+            assert got.data == inflight
+        rvol.close()
+    rec.cleanup()
+
+
+def test_torn_final_needle_is_truncated_on_load(tmp_path):
+    """Pinned regression: a record torn mid-body with its index entry
+    journaled (the crash.append.idx worst case) must be walked back by
+    load(), not served and not fatal."""
+    base = tmp_path / "3"
+    vol = generate_synthetic_volume(base, 3, n_needles=6, avg_size=180,
+                                    seed=2)
+    want = {k: vol.read_needle(k).data for k in range(1, 7)}
+    vol.close()
+
+    torn = Needle(cookie=7, id=7, data=b"x" * 300)
+    rec7 = torn.to_bytes(3)
+    size = dat_path(base).stat().st_size
+    off = size + ((-size) % 8)
+    with open(dat_path(base), "r+b") as f:
+        f.seek(off)
+        f.write(rec7[:len(rec7) - 9])   # checksum and tail lost
+    body = needle_mod.parse_header(rec7)[2]
+    with open(idx_path(base), "ab") as f:
+        f.write(IndexEntry(7, off // 8, body).to_bytes())
+
+    rvol = Volume(base, 3).load()
+    _assert_all_served(rvol, want)
+    with pytest.raises(KeyError):
+        rvol.read_needle(7)
+    # the walk-back also repaired the files, not just the map
+    assert dat_path(base).stat().st_size <= off
+    assert idx_path(base).stat().st_size % 16 == 0
+    rvol.close()
+
+
+# ---------------------------------------------------------------------------
+# vacuum crashpoints
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ["crash.vacuum.compact",
+                                   "crash.vacuum.precommit",
+                                   "crash.vacuum.midcommit"])
+def test_vacuum_crash_never_loses_or_resurrects(tmp_path, point):
+    root = tmp_path / "disk"
+    root.mkdir()
+    base = root / "7"
+    vol = generate_synthetic_volume(base, 7, n_needles=30, avg_size=220,
+                                    seed=11)
+    want = {k: vol.read_needle(k).data for k in range(1, 31)}
+    deleted = (2, 9, 17, 23, 28)
+    for k in deleted:
+        vol.delete_needle(k)
+        del want[k]
+    vol.sync()
+    vol.close()
+
+    rec = CrashRecorder(root)
+    with rec:
+        vol = Volume(base, 7).load()
+        faults.inject(point, "crash#1")
+        # compact/commit driven directly: vacuum()'s abort path is
+        # process cleanup, which a power cut never gets to run
+        with pytest.raises(SimulatedCrash):
+            state = vacuum_mod.compact(vol)
+            vacuum_mod.commit_compact(vol, state)
+    assert rec.crashed and rec.crash_point == point
+    vol.close()
+
+    for seed in REPLAY_SEEDS:
+        dest = rec.replay(tmp_path / f"r{seed}", seed=seed)
+        rvol = Volume(dest / "7", 7).load()
+        _assert_all_served(rvol, want)
+        for k in deleted:
+            with pytest.raises(KeyError):
+                rvol.read_needle(k)
+        # recovery consumed or discarded the compact leftovers
+        assert not (dest / "7.cpd").exists()
+        assert not (dest / "7.cpx").exists()
+        rvol.close()
+    rec.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# EC writeback crashpoint
+# ---------------------------------------------------------------------------
+
+
+def test_ec_writeback_crash_leaves_source_volume_intact(tmp_path):
+    root = tmp_path / "disk"
+    root.mkdir()
+    base = root / "9"
+    vol = generate_synthetic_volume(base, 9, n_needles=60, avg_size=280,
+                                    seed=4)
+    want = {k: vol.read_needle(k).data for k in range(1, 61)}
+    vol.close()
+
+    rec = CrashRecorder(root)
+    with rec:
+        faults.inject("crash.ec.writeback", "crash#1")
+        # the crash surfaces from the pipeline's writer stage; whatever
+        # wrapper it arrives in, the recording froze at the instant the
+        # fault fired
+        with pytest.raises(BaseException):
+            encode_volume(base, SCHEME)
+    assert rec.crashed and rec.crash_point == "crash.ec.writeback"
+
+    for seed in (0, 1, 2):
+        dest = rec.replay(tmp_path / f"r{seed}", seed=seed)
+        # no .ecx = no mount: partial shards are inert garbage
+        assert not (dest / "9.ecx").exists()
+        rvol = Volume(dest / "9", 9).load()
+        _assert_all_served(rvol, want)
+        rvol.close()
+    rec.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint commit point
+# ---------------------------------------------------------------------------
+
+
+class _MemClient:
+    """In-memory stand-in for the S3 gateway client: the checkpoint
+    commit protocol is object-level, so crash coverage needs no disk."""
+
+    def __init__(self):
+        self.objects = {}
+
+    def ensure_bucket(self, bucket):
+        pass
+
+    def put(self, bucket, key, data, mime="application/octet-stream"):
+        self.objects[(bucket, key)] = bytes(data)
+
+    def get(self, bucket, key):
+        try:
+            return self.objects[(bucket, key)]
+        except KeyError:
+            raise urllib.error.HTTPError(f"mem://{bucket}/{key}", 404,
+                                         "missing", None, None)
+
+    def head(self, bucket, key):
+        obj = self.objects.get((bucket, key))
+        return None if obj is None else len(obj)
+
+    def delete(self, bucket, key):
+        self.objects.pop((bucket, key), None)
+
+
+def _raise(exc):
+    raise exc
+
+
+def test_ckpt_save_crash_before_manifest_fails_closed():
+    client = _MemClient()
+    store = CheckpointStore("http://unused", client=client)
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(4, np.float32)}
+    faults.set_crash_handler(lambda p: _raise(SimulatedCrash(p)))
+    faults.inject("crash.ckpt.save", "crash#1")
+    with pytest.raises(SimulatedCrash):
+        store.save("step-1", tree)
+    # shard objects landed, the manifest did not: no checkpoint exists
+    with pytest.raises(ManifestError):
+        store.read_manifest("step-1")
+    faults.clear()
+    faults.set_crash_handler(None)
+    store.save("step-1", tree)
+    man = store.read_manifest("step-1")
+    assert {p.name for p in man.params} == {"w", "b"}
+
+
+# ---------------------------------------------------------------------------
+# durability policy helpers
+# ---------------------------------------------------------------------------
+
+
+def test_barrier_follows_fsync_policy(tmp_path, monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1])
+    with open(tmp_path / "x", "wb") as f:
+        durability.configure(mode="off")
+        durability.barrier(f, 100)
+        assert not calls
+        durability.configure(mode="commit")
+        durability.barrier(f, 100)
+        assert len(calls) == 1
+        durability.configure(mode="batch", batch_bytes=1000,
+                             batch_seconds=3600)
+        durability.barrier(f, 400)
+        assert len(calls) == 1      # under the byte budget
+        durability.barrier(f, 700)
+        assert len(calls) == 2      # budget spent -> fsync
+    durability.configure(mode="commit")
+
+
+def test_durable_replace_installs_and_consumes_source(tmp_path):
+    src = tmp_path / "a"
+    dst = tmp_path / "b"
+    src.write_bytes(b"new")
+    dst.write_bytes(b"old")
+    durability.durable_replace(src, dst)
+    assert dst.read_bytes() == b"new"
+    assert not src.exists()

@@ -1,0 +1,197 @@
+"""Scrub plane: paced CRC/sha256 verification, quarantine, auto-repair.
+
+The silent-corruption defense (docs/robustness.md "Scrub & repair"):
+injected bit-rot on a needle and on an EC shard must be *detected* by
+a scrub pass, the rotten bytes *quarantined*, and the data *repaired*
+back to sha256 identity — from a replica for needles, from parity for
+shards — with the ``seaweed_scrub_*`` counters advancing.
+"""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.pipeline.encode import encode_volume
+from seaweedfs_tpu.pipeline.scheme import EcScheme
+from seaweedfs_tpu.storage import ec_files, scrubber
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume, dat_path, \
+    generate_synthetic_volume
+
+SCHEME = EcScheme(data_shards=10, parity_shards=4,
+                  large_block_size=2048, small_block_size=256)
+
+
+def _counter(name, **labels):
+    return scrubber.METRICS.counter(name, **labels).value
+
+
+def _flip_byte(path, offset):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# pacer
+# ---------------------------------------------------------------------------
+
+
+def test_rate_pacer_budgets_bytes():
+    clock = [0.0]
+    slept = []
+
+    def sleep(s):
+        slept.append(s)
+        clock[0] += s
+
+    p = scrubber.RatePacer(1000, clock=lambda: clock[0], sleep=sleep)
+    p.take(1000)        # consumes the initial 1s burst allowance
+    p.take(500)         # over budget -> must wait 0.5s
+    assert slept and abs(sum(slept) - 0.5) < 1e-6
+    assert abs(p.slept_seconds - 0.5) < 1e-6
+
+
+def test_rate_pacer_zero_rate_never_sleeps():
+    p = scrubber.RatePacer(0, sleep=lambda s: pytest.fail("slept"))
+    for _ in range(100):
+        p.take(1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# plain-volume scrub
+# ---------------------------------------------------------------------------
+
+
+def test_scrub_clean_volume_counts_everything(tmp_path):
+    vol = generate_synthetic_volume(tmp_path / "1", 1, n_needles=20,
+                                    avg_size=200, seed=3)
+    res = scrubber.scrub_volume(vol, scrubber.RatePacer(0))
+    assert res["checked"] == 20
+    assert res["corrupt"] == 0
+    assert res["bytes"] > 0
+    st = scrubber.load_state(vol.base)
+    assert st["volume"]["checked"] == 20
+    vol.close()
+
+
+def test_scrub_detects_quarantines_and_repairs_needle(tmp_path):
+    vol = generate_synthetic_volume(tmp_path / "1", 1, n_needles=12,
+                                    avg_size=256, seed=7)
+    victim = 5
+    good_rec, off = vol.read_record(victim)
+    want_data = vol.read_needle(victim).data
+    want_sha = hashlib.sha256(want_data).hexdigest()
+    # bit-rot inside the needle body, past the header
+    _flip_byte(dat_path(vol.base), off + 30)
+    with pytest.raises(Exception):
+        vol.read_needle(victim)   # read path already refuses it
+
+    c0 = _counter("scrub_corrupt_total", kind="needle")
+    q0 = _counter("scrub_quarantined_total")
+    r0 = _counter("scrub_repaired_total", kind="needle")
+    res = scrubber.scrub_volume(
+        vol, scrubber.RatePacer(0),
+        fetch_record=lambda key: good_rec if key == victim else None)
+    assert res["corrupt"] == 1
+    assert res["repaired"] == 1
+    assert res["repair_failed"] == 0
+    # quarantined forensic copy holds the rotten bytes
+    qfiles = list(scrubber.quarantine_dir(vol.base).iterdir())
+    assert len(qfiles) == 1
+    assert qfiles[0].name == f"needle-1-{victim}.rec"
+    # the repair restored byte-identical user data
+    got = vol.read_needle(victim).data
+    assert hashlib.sha256(got).hexdigest() == want_sha
+    # counters advanced
+    assert _counter("scrub_corrupt_total", kind="needle") == c0 + 1
+    assert _counter("scrub_quarantined_total") == q0 + 1
+    assert _counter("scrub_repaired_total", kind="needle") == r0 + 1
+    vol.close()
+
+
+def test_scrub_without_fetcher_reports_repair_failed(tmp_path):
+    vol = generate_synthetic_volume(tmp_path / "2", 2, n_needles=6,
+                                    avg_size=128, seed=1)
+    _, off = vol.read_record(3)
+    _flip_byte(dat_path(vol.base), off + 25)
+    res = scrubber.scrub_volume(vol, scrubber.RatePacer(0))
+    assert res["corrupt"] == 1
+    assert res["repaired"] == 0
+    assert res["repair_failed"] == 1
+    vol.close()
+
+
+# ---------------------------------------------------------------------------
+# EC shard scrub
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sealed(tmp_path):
+    base = tmp_path / "9"
+    vol = generate_synthetic_volume(base, 9, n_needles=80, avg_size=280,
+                                    seed=5)
+    vol.close()
+    encode_volume(base, SCHEME)
+    return base
+
+
+def test_scrub_ec_establishes_baseline(sealed):
+    res = scrubber.scrub_ec(sealed, SCHEME, scrubber.RatePacer(0))
+    assert res["baseline"] is True
+    assert res["corrupt"] == 0
+    st = scrubber.load_state(sealed)
+    assert len(st["shard_sha256"]) == SCHEME.total_shards
+    # sidecar hashes match reality
+    for sid, want in st["shard_sha256"].items():
+        got = hashlib.sha256(ec_files.shard_path(
+            sealed, int(sid)).read_bytes()).hexdigest()
+        assert got == want
+
+
+def test_scrub_ec_detects_quarantines_and_rebuilds_shard(sealed):
+    scrubber.scrub_ec(sealed, SCHEME, scrubber.RatePacer(0))
+    bad = 3
+    shard = ec_files.shard_path(sealed, bad)
+    want_sha = hashlib.sha256(shard.read_bytes()).hexdigest()
+    _flip_byte(shard, shard.stat().st_size // 2)
+
+    c0 = _counter("scrub_corrupt_total", kind="ec")
+    r0 = _counter("scrub_repaired_total", kind="ec")
+    res = scrubber.scrub_ec(sealed, SCHEME, scrubber.RatePacer(0))
+    assert res["corrupt"] == 1
+    assert res["repaired"] == 1
+    assert res["repair_failed"] == 0
+    # rotten shard parked for forensics; rebuilt file is sha-identical
+    q = scrubber.quarantine_dir(sealed) / shard.name
+    assert q.exists()
+    got_sha = hashlib.sha256(shard.read_bytes()).hexdigest()
+    assert got_sha == want_sha
+    assert _counter("scrub_corrupt_total", kind="ec") == c0 + 1
+    assert _counter("scrub_repaired_total", kind="ec") == r0 + 1
+
+
+def test_scrub_ec_parity_inconsistent_bootstrap_refuses_baseline(sealed):
+    # rot BEFORE any baseline exists: the parity proof must fail and
+    # no baseline may be written (nothing can be attributed)
+    shard = ec_files.shard_path(sealed, 0)
+    _flip_byte(shard, 100)
+    res = scrubber.scrub_ec(sealed, SCHEME, scrubber.RatePacer(0))
+    assert res["baseline"] is False
+    assert res["corrupt"] == -1
+    assert "shard_sha256" not in scrubber.load_state(sealed)
+
+
+def test_scrub_state_sidecar_is_durable_json(sealed):
+    scrubber.scrub_ec(sealed, SCHEME, scrubber.RatePacer(0))
+    p = scrubber.state_path(sealed)
+    assert p.exists()
+    doc = json.loads(p.read_bytes())
+    assert "shard_sha256" in doc
+    # no .tmp left behind (the orphan sweep would eat it at startup)
+    assert not p.with_suffix(".scrub.tmp").exists()

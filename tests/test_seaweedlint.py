@@ -361,6 +361,95 @@ def test_pragma_for_other_rule_does_not_suppress():
 
 
 # ---------------------------------------------------------------------------
+# SW901 — rename commit points must be durable
+# ---------------------------------------------------------------------------
+
+# pre-PR-20 fixture: vacuum's two-phase swap renamed .cpd/.cpx into
+# place with no fsync on either side — the exact site durable_replace
+# replaced (storage/vacuum.py history)
+_BARE_SWAP = """
+    import os
+
+    def commit_compact(base):
+        os.replace(base + ".cpd", base + ".dat")
+        os.replace(base + ".cpx", base + ".idx")
+"""
+
+# pre-PR-20 fixture: a tier download moving its .part into place
+_PART_INSTALL = """
+    import os
+
+    def finish_download(part, final):
+        os.rename(part, final)
+"""
+
+
+def test_sw901_bare_rename_commit_flagged():
+    fs = only(lint(_BARE_SWAP), "SW901")
+    assert len(fs) == 2
+    assert all(f.severity == "warning" for f in fs)
+    assert "durable_replace" in fs[0].message
+
+
+def test_sw901_bare_os_rename_flagged():
+    fs = only(lint(_PART_INSTALL), "SW901")
+    assert len(fs) == 1
+
+
+def test_sw901_durable_replace_idiom_clean():
+    fs = lint("""
+        import os
+        from seaweedfs_tpu.util.durability import durable_replace
+
+        def commit(base):
+            durable_replace(base + ".cpd", base + ".dat")
+    """)
+    assert not only(fs, "SW901")
+
+
+def test_sw901_manual_fsync_pair_clean():
+    fs = lint("""
+        import os
+        from seaweedfs_tpu.util.durability import fsync_dir
+
+        def install(tmp, final):
+            fd = os.open(tmp, os.O_RDONLY)
+            os.fsync(fd)
+            os.close(fd)
+            os.replace(tmp, final)
+            fsync_dir("/data")
+    """)
+    assert not only(fs, "SW901")
+
+
+def test_sw901_fsync_on_wrong_side_still_flagged():
+    # source fsynced, but the rename's directory entry never persisted
+    fs = only(lint("""
+        import os
+
+        def install(tmp, final):
+            fd = os.open(tmp, os.O_RDONLY)
+            os.fsync(fd)
+            os.close(fd)
+            os.replace(tmp, final)
+            return final
+    """), "SW901")
+    assert len(fs) == 1
+    assert "parent directory" in fs[0].message
+
+
+def test_sw901_pragma_with_reason_suppresses():
+    fs = lint("""
+        import os
+
+        def park_corrupt(path, qdir):
+            # seaweedlint: disable=SW901 — forensic move, not a commit point
+            os.replace(path, qdir + "/bad")
+    """)
+    assert not only(fs, "SW901")
+
+
+# ---------------------------------------------------------------------------
 # Fingerprints + baseline diff
 # ---------------------------------------------------------------------------
 

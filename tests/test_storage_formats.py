@@ -21,10 +21,24 @@ def test_crc32c_known_vectors():
 
 
 def test_crc32c_fast_matches_slow():
+    # sizes straddle _BULK_THRESHOLD so both the slice-by-8 loop and
+    # the vectorized block-fold path are exercised, including every
+    # partial-final-block shape around the 64-byte block width
     rng = np.random.default_rng(0)
-    for size in (0, 1, 7, 8, 9, 63, 64, 1000):
+    for size in (0, 1, 7, 8, 9, 63, 64, 1000, 1023, 1024, 1025,
+                 4095, 4097, 70000):
         data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
-        assert crc.crc32c(data) == crc.crc32c_slow(data)
+        for init in (0, 0xDEADBEEF):
+            assert crc.crc32c(data, init) == crc.crc32c_slow(data, init)
+
+
+def test_crc32c_incremental_chaining():
+    # crc(a+b) == crc(b, crc(a)) across the small/bulk path boundary
+    rng = np.random.default_rng(1)
+    for na, nb in ((100, 5000), (5000, 100), (2048, 4096), (0, 3000)):
+        a = rng.integers(0, 256, na, dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, nb, dtype=np.uint8).tobytes()
+        assert crc.crc32c(a + b) == crc.crc32c(b, crc.crc32c(a))
 
 
 # -- file ids ---------------------------------------------------------------
